@@ -1,0 +1,23 @@
+"""flake16_framework_tpu — TPU-native rebuild of the Flake16 flaky-test framework.
+
+A brand-new framework with the capabilities of ``flake-it/flake16-framework``
+(reference layout surveyed in /root/repo/SURVEY.md), designed TPU-first:
+
+- The ML pipeline (reference ``experiment.py:410-530``) — tree-ensemble fit and
+  predict, StandardScaler/PCA preprocessing, SMOTE/Tomek/ENN resampling, stratified
+  cross-validation scoring, and path-dependent Tree SHAP — is jit-compiled JAX/XLA
+  over fixed-shape arrays, with the 216-config x 10-fold sweep laid out on a
+  ``jax.sharding.Mesh`` via ``shard_map`` (see ``parallel/``).
+- The host layers (reference ``experiment.py:103-407, 634-690``) — Docker
+  orchestration, collation, labeling, figures — are behavioral ports (see
+  ``runner/`` and ``figures/``) with a native C++ fast path for hot collation
+  loops (see ``native/``).
+
+Nothing here is a line-by-line translation: the reference's sklearn/imblearn/shap
+estimator objects become *data* (integer config codes + static model specs), and
+every numeric stage is a pure function of arrays.
+"""
+
+__version__ = "0.1.0"
+
+from flake16_framework_tpu import constants  # noqa: F401

@@ -1,0 +1,96 @@
+"""The 2x2x3x6x3 experiment grid as *data*.
+
+The reference holds a grid of instantiated sklearn/imblearn estimator objects
+(/root/reference/experiment.py:73-100) and forks processes around them. Here every
+axis is an integer code or a static spec, so a single jit-compiled graph can cover
+many configs (preprocessing and balancing are runtime codes dispatched with
+``lax.switch``; the model and feature-set axes are compile-time static).
+
+Key ordering and naming exactly match the reference grid so ``scores.pkl`` keys are
+interchangeable (reference experiment.py:493-498).
+"""
+
+import itertools
+from dataclasses import dataclass
+
+from flake16_framework_tpu.constants import (
+    FLAKY, OD_FLAKY, N_FEATURES, FLAKEFLAGGER_COLS
+)
+
+# Axis 0: flaky type -> positive label (reference experiment.py:74-77).
+FLAKY_TYPES = {"NOD": FLAKY, "OD": OD_FLAKY}
+
+# Axis 1: feature set -> column indices (reference experiment.py:78-81).
+FEATURE_SETS = {
+    "Flake16": tuple(range(N_FEATURES)),
+    "FlakeFlagger": FLAKEFLAGGER_COLS,
+}
+
+# Axis 2: preprocessing codes (reference experiment.py:82-86). All three are
+# expressible as one affine transform x' = (x - mu) @ W computed in-graph, so the
+# code is a runtime integer, not a compile-time branch.
+PREP_NONE, PREP_SCALING, PREP_PCA = 0, 1, 2
+PREPROCESSINGS = {"None": PREP_NONE, "Scaling": PREP_SCALING, "PCA": PREP_PCA}
+
+# Axis 3: balancing codes (reference experiment.py:87-94). Dispatched via
+# ``lax.switch`` over kernels sharing one pairwise-distance primitive.
+BAL_NONE, BAL_TOMEK, BAL_SMOTE, BAL_ENN, BAL_SMOTE_ENN, BAL_SMOTE_TOMEK = range(6)
+BALANCINGS = {
+    "None": BAL_NONE,
+    "Tomek Links": BAL_TOMEK,
+    "SMOTE": BAL_SMOTE,
+    "ENN": BAL_ENN,
+    "SMOTE ENN": BAL_SMOTE_ENN,
+    "SMOTE Tomek": BAL_SMOTE_TOMEK,
+}
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    """Static description of a tree-ensemble model (compile-time constant).
+
+    Captures the sklearn 1.0.2 defaults of the three reference models
+    (reference experiment.py:96-98; SURVEY.md §2 table B): 100-tree ensembles,
+    gini, unbounded depth, min_samples_split=2, min_samples_leaf=1; RF/ET use
+    max_features=sqrt(n_features), DT uses all features.
+    """
+
+    name: str
+    n_trees: int
+    bootstrap: bool
+    random_splits: bool  # True: ExtraTrees uniform-random thresholds
+    sqrt_features: bool  # True: sqrt(F) candidate features per split
+
+
+MODELS = {
+    "Extra Trees": ModelSpec("Extra Trees", 100, False, True, True),
+    "Random Forest": ModelSpec("Random Forest", 100, True, False, True),
+    "Decision Tree": ModelSpec("Decision Tree", 1, False, False, False),
+}
+
+GRID_AXES = (FLAKY_TYPES, FEATURE_SETS, PREPROCESSINGS, BALANCINGS, MODELS)
+
+
+def iter_config_keys():
+    """All 216 config key-tuples in the reference sweep order
+    (reference experiment.py:494: itertools.product over grid dict keys)."""
+    return itertools.product(*[tuple(d.keys()) for d in GRID_AXES])
+
+
+def resolve_config(config_keys):
+    """Key tuple -> (flaky_label, feature_cols, prep_code, bal_code, ModelSpec)."""
+    flaky_type, feature_set, prep, bal, model = config_keys
+    return (
+        FLAKY_TYPES[flaky_type],
+        FEATURE_SETS[feature_set],
+        PREPROCESSINGS[prep],
+        BALANCINGS[bal],
+        MODELS[model],
+    )
+
+
+# The two configs explained with Tree SHAP (reference experiment.py:523-526).
+SHAP_CONFIGS = (
+    ("NOD", "Flake16", "Scaling", "SMOTE Tomek", "Extra Trees"),
+    ("OD", "Flake16", "Scaling", "SMOTE", "Random Forest"),
+)

@@ -1,0 +1,63 @@
+"""Framework-wide constants.
+
+Behavioral contract mirrors the reference module-level constants
+(/root/reference/experiment.py:32-71); values that are part of on-disk or
+cross-process interfaces (file names, label encoding, run counts, feature order)
+are identical so artifacts remain interchangeable with the reference study.
+"""
+
+import os
+
+LOG_FILE = "log.txt"
+SHAP_FILE = "shap.pkl"
+TESTS_FILE = "tests.json"
+SCORES_FILE = "scores.pkl"
+SUBJECTS_FILE = "subjects.txt"
+REQUIREMENTS_FILE = "requirements.txt"
+
+DATA_DIR = "data"
+STDOUT_DIR = "stdout"
+WORK_DIR = os.path.join("/", "home", "user")
+SUBJECTS_DIR = os.path.join(WORK_DIR, "subjects")
+CONT_DATA_DIR = os.path.join(WORK_DIR, DATA_DIR)
+
+CONT_TIMEOUT = 7200
+PIP_VERSION = "pip==21.2.1"
+IMAGE_NAME = "flake16framework"
+PIP_INSTALL = ["pip", "install", "-I", "--no-deps"]
+
+# Label encoding (reference experiment.py:50). NOTE: the code is the contract —
+# 1 = order-dependent flaky, 2 = non-order-dependent flaky (README.rst:75 has
+# them swapped; SURVEY.md §2 row 11).
+NON_FLAKY, OD_FLAKY, FLAKY = 0, 1, 2
+
+# Runs per mode (reference experiment.py:52).
+N_RUNS = {"baseline": 2500, "shuffle": 2500, "testinspect": 1}
+
+# pytest plugins that interfere with flakiness measurement
+# (reference experiment.py:54-59).
+PLUGIN_BLACKLIST = (
+    "-p", "no:cov", "-p", "no:flaky", "-p", "no:xdist", "-p", "no:sugar",
+    "-p", "no:replay", "-p", "no:forked", "-p", "no:ordering",
+    "-p", "no:randomly", "-p", "no:flakefinder", "-p", "no:random_order",
+    "-p", "no:rerunfailures",
+)
+
+PLUGINS = (
+    os.path.join(WORK_DIR, "showflakes"), os.path.join(WORK_DIR, "testinspect")
+)
+
+# The 16 Flake16 features, column order fixed (reference experiment.py:65-71):
+# cols 0-2 from coverage, 3-8 from rusage, 9-15 static.
+FEATURE_NAMES = (
+    "Covered Lines", "Covered Changes", "Source Covered Lines",
+    "Execution Time", "Read Count", "Write Count", "Context Switches",
+    "Max. Threads", "Max. Memory", "AST Depth", "Assertions",
+    "External Modules", "Halstead Volume", "Cyclomatic Complexity",
+    "Test Lines of Code", "Maintainability"
+)
+
+N_FEATURES = len(FEATURE_NAMES)
+
+# FlakeFlagger subset column indices (reference experiment.py:80).
+FLAKEFLAGGER_COLS = (0, 1, 2, 3, 10, 11, 14)

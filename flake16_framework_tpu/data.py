@@ -1,0 +1,55 @@
+"""Dataset loading: ``tests.json`` -> fixed-shape arrays.
+
+Semantics match the reference loader (/root/reference/experiment.py:410-427):
+iterate projects in file order, then tests in file order; features are the
+per-test tuple minus the leading (req_runs, label); labels are the raw encoded
+label compared against the positive flaky label; projects expand to one entry
+per test. The TPU build additionally returns integer project ids (for on-device
+segment reductions) alongside the string array.
+"""
+
+import json
+
+import numpy as np
+
+from flake16_framework_tpu.constants import N_FEATURES
+
+
+def load_tests(tests_file):
+    with open(tests_file, "r") as fd:
+        return json.load(fd)
+
+
+def tests_to_arrays(tests):
+    """tests dict -> (features [N,16] f64, labels_raw [N] i32, projects [N] str,
+    project_names list, project_ids [N] i32).
+
+    ``labels_raw`` keeps the 0/1/2 encoding; callers binarize against a flaky
+    label (reference experiment.py:424) so one load serves both NOD and OD
+    configs.
+    """
+    features, labels, projects = [], [], []
+
+    for proj, tests_proj in tests.items():
+        projects += [proj] * len(tests_proj)
+
+        for (_, label_nid, *features_nid) in tests_proj.values():
+            features.append(features_nid)
+            labels.append(label_nid)
+
+    features = np.asarray(features, dtype=np.float64).reshape(-1, N_FEATURES)
+    labels = np.asarray(labels, dtype=np.int32)
+    projects = np.asarray(projects)
+
+    project_names = list(dict.fromkeys(projects.tolist()))
+    name_to_id = {p: i for i, p in enumerate(project_names)}
+    project_ids = np.asarray([name_to_id[p] for p in projects], dtype=np.int32)
+
+    return features, labels, projects, project_names, project_ids
+
+
+def load_feat_lab_proj(flaky_label, feature_set, tests_file):
+    """Reference-shaped loader (experiment.py:410-427): returns
+    (features[:, feature_set], labels == flaky_label, projects)."""
+    features, labels, projects, _, _ = tests_to_arrays(load_tests(tests_file))
+    return features[:, list(feature_set)], labels == flaky_label, projects

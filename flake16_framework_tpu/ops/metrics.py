@@ -1,0 +1,81 @@
+"""Confusion accumulation and precision/recall/F1.
+
+Replicates the reference scoring semantics (/root/reference/experiment.py:430-443,
+476-486): the index trick ``k = 2*label + pred - 1`` maps (TN, FP, FN, TP) to
+(-1, 0, 1, 2); TN is skipped; counts accumulate per project and in total; P/R/F
+propagate ``None`` on zero denominators.
+
+Device side is a single ``segment_sum`` over ``project_id * 3 + k`` — no Python
+loops over samples — so it fuses into the jitted per-config scoring graph.
+Host side formats counts into the reference's ``scores.pkl`` schema
+(README.rst:78-134).
+"""
+
+import jax.numpy as jnp
+import jax.ops
+
+
+def confusion_by_project(labels, preds, test_mask, project_ids, n_projects):
+    """Accumulate (FP, FN, TP) per project over fold-test samples.
+
+    labels: [N] bool/int — true binary labels.
+    preds: [..., N] predictions (leading axes e.g. folds).
+    test_mask: [..., N] 0/1 — which samples are scored in each fold
+      (reference scores only fold-test rows, experiment.py:460-482).
+    project_ids: [N] int32.
+    Returns counts [n_projects, 3] int32, ordered (FP, FN, TP).
+    """
+    labels = labels.astype(jnp.int32)
+    preds = preds.astype(jnp.int32)
+    k = 2 * labels[None, :] + preds.reshape(-1, labels.shape[0]) - 1
+    mask = (test_mask.reshape(k.shape) > 0) & (k >= 0)
+
+    seg = project_ids[None, :] * 3 + jnp.maximum(k, 0)
+    counts = jax.ops.segment_sum(
+        mask.astype(jnp.int32).ravel(), seg.ravel(), num_segments=n_projects * 3
+    )
+    return counts.reshape(n_projects, 3)
+
+
+def div_none(a, b):
+    return a / b if b else None
+
+
+def get_prf(fp, fn, tp):
+    """Precision/recall/F1 with None on zero denominators
+    (reference experiment.py:430-443)."""
+    p = div_none(tp, tp + fp)
+    r = div_none(tp, tp + fn)
+
+    if p is None or r is None:
+        f = None
+    else:
+        f = div_none(2 * p * r, p + r)
+
+    return p, r, f
+
+
+def format_scores(counts, project_names, all_projects):
+    """counts [P,3] -> (scores dict, scores_total list) in reference schema:
+    ``scores[proj] = [fp, fn, tp, p, r, f]`` (README.rst:120-134).
+
+    ``all_projects`` is the per-sample project string array: the reference seeds
+    its dict from it (experiment.py:456), so projects keep dataset order and
+    projects with zero scored samples still appear.
+    """
+    counts = [[int(x) for x in row] for row in counts]
+    order = list(dict.fromkeys(project_names))
+
+    scores = {}
+    total = [0, 0, 0]
+    for pid, proj in enumerate(order):
+        fp, fn, tp = counts[pid]
+        scores[proj] = [fp, fn, tp, *get_prf(fp, fn, tp)]
+        total[0] += fp
+        total[1] += fn
+        total[2] += tp
+
+    # Preserve reference dict ordering: first-seen order over the sample array.
+    seen = {p: scores[p] for p in dict.fromkeys(list(all_projects))}
+    scores_total = [*total, *get_prf(*total)]
+    return seen, scores_total

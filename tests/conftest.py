@@ -1,0 +1,19 @@
+"""Test harness: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is unavailable in CI; per SURVEY.md §4 the analog of the
+reference's fake-plugin-output strategy is to fake the *mesh*, not the TPU —
+sharding/collective logic is validated on N virtual CPU devices, numerics on tiny
+shapes. Env vars must be set before jax initializes, hence at conftest import.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,59 @@
+"""Grid enumeration and dataset loading semantics."""
+
+import numpy as np
+
+from flake16_framework_tpu import config
+from flake16_framework_tpu.constants import FLAKY, OD_FLAKY
+from flake16_framework_tpu.data import load_feat_lab_proj, tests_to_arrays
+from flake16_framework_tpu.utils.synth import make_tests_json
+
+
+def test_grid_is_216_in_reference_order():
+    keys = list(config.iter_config_keys())
+    assert len(keys) == 216
+    # First key: first entry of each axis dict (reference product order).
+    assert keys[0] == ("NOD", "Flake16", "None", "None", "Extra Trees")
+    # Model axis cycles fastest.
+    assert keys[1] == ("NOD", "Flake16", "None", "None", "Random Forest")
+    assert keys[2] == ("NOD", "Flake16", "None", "None", "Decision Tree")
+    assert keys[3] == ("NOD", "Flake16", "None", "Tomek Links", "Extra Trees")
+    # OD block is the second half.
+    assert keys[108][0] == "OD"
+
+
+def test_resolve_config():
+    label, cols, prep, bal, model = config.resolve_config(
+        ("NOD", "FlakeFlagger", "PCA", "SMOTE", "Decision Tree")
+    )
+    assert label == FLAKY
+    assert cols == (0, 1, 2, 3, 10, 11, 14)
+    assert prep == config.PREP_PCA
+    assert bal == config.BAL_SMOTE
+    assert model.n_trees == 1 and not model.sqrt_features
+
+
+def test_loader_roundtrip(tmp_path):
+    path = tmp_path / "tests.json"
+    make_tests_json(str(path), n_tests=300, n_projects=5, seed=1)
+
+    feats, labels, projects = load_feat_lab_proj(
+        FLAKY, tuple(range(16)), str(path)
+    )
+    assert feats.shape == (300, 16)
+    assert labels.dtype == bool
+    assert len(projects) == 300
+
+    feats7, labels_od, _ = load_feat_lab_proj(
+        OD_FLAKY, (0, 1, 2, 3, 10, 11, 14), str(path)
+    )
+    assert feats7.shape == (300, 7)
+    np.testing.assert_array_equal(feats7[:, 0], feats[:, 0])
+    assert labels_od.sum() > 0 and not np.array_equal(labels, labels_od)
+
+    # project ids follow first-seen order
+    import json
+    _, _, proj_arr, names, pids = tests_to_arrays(
+        json.loads(path.read_text())
+    )
+    assert names == sorted(names)
+    assert proj_arr[0] == names[pids[0]]

@@ -1,0 +1,33 @@
+"""Fold assignment must replicate sklearn's StratifiedKFold exactly —
+fold membership is the one place the reference's RNG is bit-reproducible
+(SURVEY.md §7 step 6)."""
+
+import numpy as np
+import pytest
+from sklearn.model_selection import StratifiedKFold
+
+from flake16_framework_tpu.parallel.folds import stratified_fold_ids, fold_masks
+
+
+@pytest.mark.parametrize("n,flaky_frac,seed", [
+    (100, 0.1, 0), (257, 0.07, 0), (1000, 0.05, 0), (97, 0.3, 3),
+])
+def test_matches_sklearn(n, flaky_frac, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.rand(n) < flaky_frac
+    X = rng.rand(n, 4)
+
+    ids = stratified_fold_ids(labels, 10, 0)
+
+    skf = StratifiedKFold(n_splits=10, shuffle=True, random_state=0)
+    for k, (train, test) in enumerate(skf.split(X, labels)):
+        np.testing.assert_array_equal(np.flatnonzero(ids == k), test)
+        np.testing.assert_array_equal(np.flatnonzero(ids != k), train)
+
+
+def test_masks_partition():
+    labels = np.random.RandomState(0).rand(200) < 0.1
+    train, test = fold_masks(labels)
+    assert train.shape == (10, 200) and test.shape == (10, 200)
+    np.testing.assert_array_equal(train + test, np.ones((10, 200)))
+    np.testing.assert_array_equal(test.sum(axis=0), np.ones(200))

@@ -1,0 +1,63 @@
+"""Scoring semantics vs a direct numpy transcription of the reference
+accumulation loop (experiment.py:476-486)."""
+
+import numpy as np
+
+from flake16_framework_tpu.ops.metrics import (
+    confusion_by_project, get_prf, format_scores
+)
+
+
+def reference_scores(labels, preds, test_mask, projects):
+    """Literal reimplementation of the reference loop for cross-checking."""
+    scores = {proj: [0] * 3 for proj in projects}
+    total = [0] * 3
+    for f in range(preds.shape[0]):
+        for j in range(len(labels)):
+            if not test_mask[f, j]:
+                continue
+            k = int(2 * labels[j] + preds[f, j]) - 1
+            if k == -1:
+                continue
+            scores[projects[j]][k] += 1
+            total[k] += 1
+    return scores, total
+
+
+def test_confusion_matches_reference_loop():
+    rng = np.random.RandomState(0)
+    n, folds, n_proj = 300, 10, 5
+    labels = rng.rand(n) < 0.2
+    preds = rng.rand(folds, n) < 0.3
+    project_ids = rng.randint(0, n_proj, n)
+    projects = np.array([f"p{i}" for i in project_ids])
+    fold_id = rng.randint(0, folds, n)
+    test_mask = (fold_id[None, :] == np.arange(folds)[:, None]).astype(np.float32)
+
+    counts = np.asarray(confusion_by_project(
+        labels, preds, test_mask, project_ids, n_proj
+    ))
+
+    ref, ref_total = reference_scores(labels, preds, test_mask, projects)
+    for i in range(n_proj):
+        assert counts[i].tolist() == ref[f"p{i}"]
+    assert counts.sum(axis=0).tolist() == ref_total
+
+
+def test_prf_none_semantics():
+    assert get_prf(0, 0, 0) == (None, None, None)
+    assert get_prf(1, 0, 0) == (0.0, None, None)
+    assert get_prf(0, 1, 0) == (None, 0.0, None)
+    p, r, f = get_prf(1, 1, 3)
+    assert abs(p - 0.75) < 1e-12 and abs(r - 0.75) < 1e-12
+    assert abs(f - 0.75) < 1e-12
+
+
+def test_format_scores_schema():
+    counts = np.array([[1, 2, 3], [0, 0, 0]])
+    projects = np.array(["a", "a", "b"])
+    scores, total = format_scores(counts, ["a", "b"], projects)
+    assert list(scores) == ["a", "b"]
+    assert scores["a"][:3] == [1, 2, 3]
+    assert scores["b"] == [0, 0, 0, None, None, None]
+    assert total[:3] == [1, 2, 3]
