@@ -1,0 +1,69 @@
+"""Preprocessing as one affine transform — StandardScaler and Scaler->PCA.
+
+The reference's preprocessing axis (/root/reference/experiment.py:82-86) is
+{None, StandardScaler, Pipeline(StandardScaler -> PCA(random_state=0))}, fit on
+the FULL dataset before CV (experiment.py:452-453 — the leakage is faithful
+behavior, SURVEY.md §2 row 15).
+
+TPU-first observation: all three are affine maps ``x' = (x - mu) @ W``, so the
+axis is *runtime data* — a ``lax.switch`` over three parameter builders inside
+one jitted graph — not three compiled variants. PCA(n_components=None,
+whiten=False) keeps all components; SVD on the [N, F<=16] matrix is tiny for XLA.
+
+Sign convention follows the reference pin (sklearn 1.0.2 ``PCA._fit_full``:
+``svd_flip`` with u_based_decision=True — per-component sign from the largest-
+magnitude entry of U). Sign choice is irrelevant to downstream tree F1 (splits
+mirror), but we keep the pinned convention for artifact comparability.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from flake16_framework_tpu.config import PREP_NONE, PREP_SCALING, PREP_PCA  # noqa: F401 (codes documented here)
+
+
+def _scaler_params(x):
+    """StandardScaler(with_mean=True, with_std=True), ddof=0; zero-variance
+    columns get scale 1 (sklearn _handle_zeros_in_scale)."""
+    mu = x.mean(axis=0)
+    sd = jnp.sqrt(jnp.maximum(x.var(axis=0), 0.0))
+    sd = jnp.where(sd == 0.0, 1.0, sd)
+    return mu, sd
+
+
+def fit_preprocess(x, prep_code):
+    """Return (mu [F], W [F,F]) such that transform(x) == (x - mu) @ W for the
+    preprocessing selected by ``prep_code`` (PREP_NONE/PREP_SCALING/PREP_PCA).
+    Jit-safe: ``prep_code`` is a traced int32 dispatched with lax.switch.
+    """
+    n, f = x.shape
+    dt = x.dtype
+
+    def none_():
+        return jnp.zeros((f,), dt), jnp.eye(f, dtype=dt)
+
+    def scaling_():
+        mu, sd = _scaler_params(x)
+        return mu, jnp.diag(1.0 / sd).astype(dt)
+
+    def pca_():
+        mu, sd = _scaler_params(x)
+        xs = (x - mu) / sd
+        mu2 = xs.mean(axis=0)  # ~0, kept for exactness (PCA re-centers)
+        xc = xs - mu2
+        _, _, vt = jnp.linalg.svd(xc, full_matrices=False)
+        # svd_flip(u_based): sign from U's max-|.| row; U column = Xc @ v / s,
+        # so sign(U[i,j]) == sign((Xc @ vt[j])[i]) and we avoid materializing U.
+        proj = xc @ vt.T  # [N, F] = U * S
+        idx = jnp.argmax(jnp.abs(proj), axis=0)
+        signs = jnp.sign(proj[idx, jnp.arange(f)])
+        signs = jnp.where(signs == 0, 1.0, signs)
+        vt = vt * signs[:, None]
+        w = jnp.diag(1.0 / sd).astype(dt) @ vt.T
+        return mu + mu2 * sd, w
+
+    return lax.switch(prep_code, (none_, scaling_, pca_))
+
+
+def transform(x, mu, w):
+    return (x - mu[None, :]) @ w

@@ -1,0 +1,381 @@
+"""Tree-ensemble fit/predict as pure jitted JAX — the replacement for sklearn's
+Cython tree stack (SURVEY.md §2 table B rows 1-3; reference call sites
+/root/reference/experiment.py:96-98,469,473).
+
+Design (TPU-first, not a port):
+
+- **Static shapes.** A tree is a fixed-capacity structure-of-arrays
+  (``Forest``): ``max_nodes`` slots regardless of data. Growth is level-by-level
+  for ``max_depth`` iterations of a ``fori_loop``; a node that cannot split
+  simply never changes, so finished trees are a fixed point and no dynamic
+  control flow is needed.
+- **Exact gini best-splits without per-node loops.** Per feature, sample order
+  by value is precomputed once; each level a single *stable* argsort by node id
+  yields (node, value)-lexicographic order, so weighted class prefix sums +
+  per-node base offsets give every candidate split's left/right counts in one
+  cumsum. This is the sort-based exact split of GPU gradient-boosting systems,
+  mapped to XLA ops (batched over the feature axis, vmapped over trees).
+- **Integer-exact scoring.** Weighted counts are small integers, exact in f32;
+  the gini proxy is reformulated as ``d_L^2/w_L + d_R^2/w_R`` with
+  ``d = w0 - w1`` (equal to sklearn's proxy up to a per-node constant), which
+  removes the large constant term and keeps comparisons well-conditioned
+  without f64.
+- **Masking, not dynamic shapes.** Fold membership, resampler validity, and
+  bootstrap multiplicities all arrive as one per-sample weight vector; rows
+  with zero weight are parked in a dummy segment and never influence splits,
+  thresholds, or leaf values — the moral equivalent of sklearn fitting on a
+  shorter array, under XLA's static-shape rules.
+
+Replicated sklearn 1.0.2 semantics (defaults of the reference estimators):
+gini, ``splitter=best``/``random``, unbounded depth (bounded here by a generous
+``max_depth``), ``min_samples_split=2``, ``min_samples_leaf=1``,
+``max_features=sqrt`` for the ensembles and all features for the single tree,
+midpoint thresholds with the ``<=`` left rule, candidate features drawn in
+random order skipping constant features, pure nodes never split.
+"""
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# sklearn's FEATURE_THRESHOLD: two values closer than this are "equal" for
+# split-candidate purposes.
+FEATURE_EPS = 1e-7
+
+
+class Forest(NamedTuple):
+    """Structure-of-arrays tree ensemble. Shapes: [T, M] (+ [T, M, 2] value).
+
+    ``feature`` is -1 at leaves; ``value`` holds *weighted class counts* for
+    every node ever populated (internal nodes too — Tree SHAP needs node cover
+    weights), normalized only at predict time like sklearn's predict_proba.
+    """
+
+    feature: jax.Array
+    threshold: jax.Array
+    left: jax.Array
+    right: jax.Array
+    value: jax.Array
+    n_nodes: jax.Array
+    max_depth: jax.Array  # scalar i32: depth bound used at fit time; predict
+    # derives its traversal length from this so fit/predict can't disagree.
+
+
+def _exclusive_cumsum(x):
+    return jnp.concatenate([jnp.zeros_like(x[:1]), jnp.cumsum(x)[:-1]])
+
+
+def _proxy_score(lw, lwy, rw, rwy, valid):
+    """Weighted-gini proxy, maximized over candidates. Equal to sklearn's
+    proxy up to a per-node constant: with d = w0 - w1 per side,
+    d_L^2/w_L + d_R^2/w_R (see module docstring on conditioning)."""
+    d_l = lw - 2.0 * lwy
+    d_r = rw - 2.0 * rwy
+    score = (
+        d_l * d_l / jnp.maximum(lw, 1.0) + d_r * d_r / jnp.maximum(rw, 1.0)
+    )
+    return jnp.where(valid, score, -jnp.inf)
+
+
+def _select_features(nc, key, max_features):
+    """sklearn splitter feature sampling: draw features in uniform-random order,
+    skip constants, stop after ``max_features`` non-constant ones.
+
+    nc: [M1, F] bool — feature non-constant within node.
+    Returns sel [M1, F] bool. With fewer than max_features non-constant
+    features, all of them are selected (sklearn exhausts the draw).
+    """
+    if max_features is None:
+        return nc
+    u = jax.random.uniform(key, nc.shape)
+    r = jnp.where(nc, u, jnp.inf)
+    kth = jnp.sort(r, axis=1)[:, max_features - 1 : max_features]
+    return (r <= kth) & nc
+
+
+def _best_exact_splits(sample_node, w, wy, order0, xsorted, x, tot_w, tot_wy,
+                       max_nodes):
+    """Exact best-split search over all features for all current nodes.
+
+    Returns (score [F, M1], thr [F, M1], nonconstant [F, M1]) where M1 =
+    max_nodes + 1 (last segment parks zero-weight samples).
+    """
+    m1 = max_nodes + 1
+    n = sample_node.shape[0]
+
+    node_of = sample_node[order0]  # [F, N]
+    perm = jnp.argsort(node_of, axis=1, stable=True)
+    sidx = jnp.take_along_axis(order0, perm, axis=1)
+    s_node = jnp.take_along_axis(node_of, perm, axis=1)
+    s_val = jnp.take_along_axis(xsorted, perm, axis=1)
+    s_w = w[sidx]
+    s_wy = wy[sidx]
+
+    cw = jnp.cumsum(s_w, axis=1)
+    cwy = jnp.cumsum(s_wy, axis=1)
+    start_w = _exclusive_cumsum(tot_w)
+    start_wy = _exclusive_cumsum(tot_wy)
+
+    lw = cw - start_w[s_node]
+    lwy = cwy - start_wy[s_node]
+    rw = tot_w[s_node] - lw
+    rwy = tot_wy[s_node] - lwy
+
+    nxt_node = jnp.concatenate([s_node[:, 1:], jnp.full_like(s_node[:, :1], -1)],
+                               axis=1)
+    nxt_val = jnp.concatenate([s_val[:, 1:], s_val[:, :1]], axis=1)
+    valid = (
+        (s_node == nxt_node)
+        & (s_node < max_nodes)
+        & (nxt_val - s_val > FEATURE_EPS)
+        & (lw > 0)
+        & (rw > 0)
+    )
+
+    score = _proxy_score(lw, lwy, rw, rwy, valid)
+
+    seg = jax.vmap(
+        lambda s, ids: jax.ops.segment_max(s, ids, num_segments=m1,
+                                           indices_are_sorted=True)
+    )
+    best = seg(score, s_node)  # [F, M1]
+
+    at_best = valid & (score == jnp.take_along_axis(best, s_node, axis=1))
+    pos = jnp.where(at_best, jnp.arange(n)[None, :], n)
+    segmin = jax.vmap(
+        lambda s, ids: jax.ops.segment_min(s, ids, num_segments=m1,
+                                           indices_are_sorted=True)
+    )
+    best_pos = jnp.clip(segmin(pos, s_node), 0, n - 2)  # [F, M1]
+
+    v_lo = jnp.take_along_axis(s_val, best_pos, axis=1)
+    v_hi = jnp.take_along_axis(s_val, best_pos + 1, axis=1)
+    thr = (v_lo + v_hi) / 2.0
+    thr = jnp.where(thr == v_hi, v_lo, thr)  # sklearn midpoint rounding guard
+
+    return best, thr, jnp.isfinite(best)
+
+
+def _best_random_splits(sample_node, w, wy, x, tot_w, tot_wy, max_nodes, key):
+    """ExtraTrees random-threshold splits: per (node, feature) threshold uniform
+    in [node_min, node_max), best among candidate features by the same proxy.
+    No sorting — only segment min/max/sum — which is why ExtraTrees is the
+    TPU-friendliest of the three reference models (SURVEY.md §2 table B)."""
+    m1 = max_nodes + 1
+    pos_w = w > 0
+
+    xt = x.T  # [F, N]
+    seg_min = jax.vmap(
+        lambda v: jax.ops.segment_min(jnp.where(pos_w, v, jnp.inf), sample_node,
+                                      num_segments=m1)
+    )
+    seg_max = jax.vmap(
+        lambda v: jax.ops.segment_max(jnp.where(pos_w, v, -jnp.inf), sample_node,
+                                      num_segments=m1)
+    )
+    nmin = seg_min(xt)  # [F, M1]
+    nmax = seg_max(xt)
+    nc = nmax > nmin + FEATURE_EPS
+
+    u = jax.random.uniform(key, nmin.shape, dtype=x.dtype)
+    thr = nmin + u * (nmax - nmin)
+    thr = jnp.where(thr >= nmax, nmin, thr)  # sklearn RandomSplitter guard
+
+    t_s = thr[:, :][:, sample_node]  # [F, N] threshold of each sample's node
+    left = xt <= t_s
+
+    seg_sum = jax.vmap(
+        lambda v: jax.ops.segment_sum(v, sample_node, num_segments=m1)
+    )
+    lw = seg_sum(jnp.where(left, w[None, :], 0.0))
+    lwy = seg_sum(jnp.where(left, wy[None, :], 0.0))
+    rw = tot_w[None, :] - lw
+    rwy = tot_wy[None, :] - lwy
+
+    valid = nc & (lw > 0) & (rw > 0)
+    score = _proxy_score(lw, lwy, rw, rwy, valid)
+
+    return score, thr, nc
+
+
+def _fit_one_tree(x, y01, w, key, order0, xsorted, *, random_splits,
+                  max_features, max_depth, max_nodes):
+    """Grow one tree level-by-level. All shapes static; returns Forest fields."""
+    n, _ = x.shape
+    m = max_nodes
+    dt = x.dtype
+
+    feature = jnp.full((m,), -1, jnp.int32)
+    threshold = jnp.zeros((m,), dt)
+    left = jnp.full((m,), -1, jnp.int32)
+    right = jnp.full((m,), -1, jnp.int32)
+    value = jnp.zeros((m, 2), dt)
+    n_nodes = jnp.int32(1)
+    # Zero-weight rows live in the parked segment `m` and never resurface.
+    sample_node = jnp.where(w > 0, 0, m).astype(jnp.int32)
+
+    wy = w * y01
+
+    def level(d, state):
+        feature, threshold, left, right, value, n_nodes, sample_node = state
+        kf, kt = jax.random.split(jax.random.fold_in(key, d))
+
+        tot_w = jax.ops.segment_sum(w, sample_node, num_segments=m + 1)
+        tot_wy = jax.ops.segment_sum(wy, sample_node, num_segments=m + 1)
+
+        # Record cover/class counts the first time a node holds samples.
+        counts = jnp.stack([tot_w - tot_wy, tot_wy], axis=-1)[:m]
+        value = jnp.where(tot_w[:m, None] > 0, counts, value)
+
+        impure = (tot_wy > 0) & (tot_w - tot_wy > 0)
+
+        if random_splits:
+            score, thr, nc = _best_random_splits(
+                sample_node, w, wy, x, tot_w, tot_wy, m, kt
+            )
+        else:
+            score, thr, nc = _best_exact_splits(
+                sample_node, w, wy, order0, xsorted, x, tot_w, tot_wy, m
+            )
+
+        sel = _select_features(nc.T, kf, max_features)  # [M1, F]
+        score = jnp.where(sel.T, score, -jnp.inf)
+        best_f = jnp.argmax(score, axis=0).astype(jnp.int32)  # [M1]
+        best_score = jnp.max(score, axis=0)
+        thr_node = jnp.take_along_axis(thr, best_f[None, :], axis=0)[0]
+
+        ids = jnp.arange(m + 1)
+        can_split = jnp.isfinite(best_score) & impure & (ids < m)
+        rank = _exclusive_cumsum(can_split.astype(jnp.int32))
+        left_id = n_nodes + 2 * rank
+        right_id = left_id + 1
+        can_split = can_split & (right_id < m)  # capacity guard (never hit
+        # when max_nodes >= 2 * n_live_samples, the default)
+
+        cs = can_split[:m]
+        feature = jnp.where(cs, best_f[:m], feature)
+        threshold = jnp.where(cs, thr_node[:m].astype(dt), threshold)
+        left = jnp.where(cs, left_id[:m].astype(jnp.int32), left)
+        right = jnp.where(cs, right_id[:m].astype(jnp.int32), right)
+        n_nodes = n_nodes + 2 * jnp.sum(can_split, dtype=jnp.int32)
+
+        node_s = sample_node
+        moving = can_split[node_s] & (w > 0)
+        f_s = best_f[node_s]
+        go_left = jnp.take_along_axis(x, f_s[:, None], axis=1)[:, 0] <= (
+            thr_node[node_s]
+        )
+        child = jnp.where(go_left, left_id[node_s], right_id[node_s])
+        sample_node = jnp.where(moving, child, node_s).astype(jnp.int32)
+
+        return feature, threshold, left, right, value, n_nodes, sample_node
+
+    state = (feature, threshold, left, right, value, n_nodes, sample_node)
+    state = lax.fori_loop(0, max_depth, level, state)
+    feature, threshold, left, right, value, n_nodes, sample_node = state
+
+    # Children created on the final level have had no value-recording pass yet
+    # (the loop records counts at the *start* of each level); one last
+    # segment_sum fills them so every reachable leaf has a distribution.
+    tot_w = jax.ops.segment_sum(w, sample_node, num_segments=m + 1)
+    tot_wy = jax.ops.segment_sum(wy, sample_node, num_segments=m + 1)
+    counts = jnp.stack([tot_w - tot_wy, tot_wy], axis=-1)[:m]
+    value = jnp.where(tot_w[:m, None] > 0, counts, value)
+
+    return feature, threshold, left, right, value, n_nodes
+
+
+def _bootstrap_weights(w, key, n_draws_hint=None):
+    """Multinomial bootstrap over rows with positive weight (sklearn RF draws
+    n_train samples with replacement; here n_train = round(sum(w))). Inverse-CDF
+    sampling keeps memory at O(N), not O(N^2) like gumbel-categorical."""
+    n = w.shape[0]
+    total = jnp.sum(w)
+    cdf = jnp.cumsum(w) / jnp.maximum(total, 1.0)
+    u = jax.random.uniform(key, (n,))
+    # side='right': smallest idx with cdf[idx] > u — a draw of exactly 0.0
+    # must not select a leading zero-weight (fold-excluded) row.
+    idx = jnp.searchsorted(cdf, u, side="right")
+    keep = jnp.arange(n) < jnp.round(total).astype(jnp.int32)
+    return jnp.zeros_like(w).at[jnp.clip(idx, 0, n - 1)].add(
+        jnp.where(keep, 1.0, 0.0)
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_trees", "bootstrap", "random_splits", "sqrt_features", "max_depth",
+        "max_nodes",
+    ),
+)
+def fit_forest(x, y, w, key, *, n_trees, bootstrap, random_splits,
+               sqrt_features, max_depth=48, max_nodes=None):
+    """Fit an ensemble. x [N,F]; y [N] (bool/int); w [N] >= 0 sample weights
+    (0 = row excluded). Returns Forest with [T, ...] leading axis.
+
+    DecisionTree = n_trees=1, bootstrap=False, random_splits=False,
+    sqrt_features=False. RandomForest = 100/True/False/True.
+    ExtraTrees = 100/False/True/True. (reference experiment.py:96-98)
+    """
+    n, f = x.shape
+    if max_nodes is None:
+        max_nodes = 2 * n
+    max_features = max(1, int(f ** 0.5)) if sqrt_features else None
+
+    y01 = y.astype(x.dtype)
+    w = w.astype(x.dtype)
+
+    if random_splits:
+        order0 = xsorted = None
+    else:
+        order0 = jnp.argsort(x.T, axis=1, stable=True).astype(jnp.int32)
+        xsorted = jnp.take_along_axis(x.T, order0, axis=1)
+
+    keys = jax.random.split(key, n_trees)
+
+    def one(k):
+        kb, kg = jax.random.split(k)
+        wt = _bootstrap_weights(w, kb) if bootstrap else w
+        return _fit_one_tree(
+            x, y01, wt, kg, order0, xsorted, random_splits=random_splits,
+            max_features=max_features, max_depth=max_depth, max_nodes=max_nodes,
+        )
+
+    feature, threshold, left, right, value, n_nodes = jax.vmap(one)(keys)
+    return Forest(feature, threshold, left, right, value, n_nodes,
+                  jnp.int32(max_depth))
+
+
+@jax.jit
+def predict_proba(forest, x):
+    """Mean of per-tree leaf class distributions (sklearn soft vote:
+    ensemble predict_proba averages per-tree normalized leaf counts).
+    Traversal length comes from the forest's own fit-time depth bound."""
+    s = x.shape[0]
+    depth = jnp.max(forest.max_depth)  # scalar even if forests were stacked
+
+    def one(feature, threshold, left, right, value):
+        def step(_, node):
+            f = feature[node]
+            leaf = f < 0
+            xv = jnp.take_along_axis(x, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+            nxt = jnp.where(xv <= threshold[node], left[node], right[node])
+            return jnp.where(leaf, node, nxt)
+
+        node = lax.fori_loop(0, depth + 1, step, jnp.zeros(s, jnp.int32))
+        v = value[node]
+        return v / jnp.maximum(v.sum(-1, keepdims=True), 1e-30)
+
+    probs = jax.vmap(one)(forest.feature, forest.threshold, forest.left,
+                          forest.right, forest.value)
+    return jnp.mean(probs, axis=0)
+
+
+def predict(forest, x):
+    """Binary predict: class 1 iff p1 > p0 (argmax tie -> class 0, like np.argmax)."""
+    p = predict_proba(forest, x)
+    return p[:, 1] > p[:, 0]

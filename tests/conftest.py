@@ -14,6 +14,16 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     + " --xla_force_host_platform_device_count=8"
 )
-os.environ.setdefault("JAX_ENABLE_X64", "1")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# This jaxlib build ignores the JAX_ENABLE_X64 env var; set it via config so
+# CPU parity tests can compare against sklearn in full precision.
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+# The axon sitecustomize registers the TPU-tunnel backend in every process
+# (before conftest runs) and overrides jax_platforms; initializing it can block
+# forever on the single-claim tunnel. Force the platform list back to cpu so
+# the axon backend is never initialized in tests.
+jax.config.update("jax_platforms", "cpu")

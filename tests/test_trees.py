@@ -1,0 +1,113 @@
+"""Tree kernel parity vs sklearn (the reference's model stack, SURVEY.md §4:
+numerical parity tests for every kernel against the sklearn golden path)."""
+
+import numpy as np
+import jax
+import pytest
+from sklearn.ensemble import ExtraTreesClassifier, RandomForestClassifier
+from sklearn.metrics import f1_score
+from sklearn.tree import DecisionTreeClassifier
+
+from flake16_framework_tpu.ops.trees import fit_forest, predict, predict_proba
+
+
+def _data(n=400, f=16, seed=0, signal=2.0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f)
+    logits = signal * x[:, 0] - signal * x[:, 3] + 0.5 * rng.randn(n)
+    y = logits > np.percentile(logits, 85)
+    return x, y
+
+
+def _fit_dt(x, y, w=None, **kw):
+    if w is None:
+        w = np.ones(len(y))
+    return fit_forest(
+        x, y, w, jax.random.PRNGKey(0), n_trees=1, bootstrap=False,
+        random_splits=False, sqrt_features=False, **kw
+    )
+
+
+def test_dt_perfectly_fits_train():
+    x, y = _data(300)
+    forest = _fit_dt(x, y)
+    np.testing.assert_array_equal(np.asarray(predict(forest, x)), y)
+
+
+def test_dt_within_sklearn_seed_noise():
+    # Split-score ties at small nodes are broken by sklearn's internal RNG
+    # (irreproducible in a BFS builder); the honest parity bar is that our
+    # tree sits inside sklearn's own seed-to-seed envelope: agreement with
+    # rs=0 no worse than other seeds' agreement with rs=0, F1 inside the
+    # seed family's range (measured noise: agreement 0.956-0.989, dF1 up
+    # to 0.062 across sklearn seeds on this data).
+    x, y = _data(400, seed=1)
+    xt, yt = _data(1000, seed=2)
+
+    sks = [DecisionTreeClassifier(random_state=rs).fit(x, y) for rs in range(4)]
+    sk_preds = [sk.predict(xt) for sk in sks]
+    sk_f1 = [f1_score(yt, p) for p in sk_preds]
+    seed_agree = min((sk_preds[0] == p).mean() for p in sk_preds[1:])
+
+    forest = _fit_dt(x, y)
+    ours = np.asarray(predict(forest, xt))
+
+    assert (ours == sk_preds[0]).mean() >= seed_agree - 0.02
+    assert min(sk_f1) - 0.03 <= f1_score(yt, ours) <= max(sk_f1) + 0.03
+
+
+def test_dt_depth_and_node_count_close_to_sklearn():
+    x, y = _data(400, seed=3)
+    sk = DecisionTreeClassifier(random_state=0).fit(x, y)
+    forest = _fit_dt(x, y)
+    n_ours = int(forest.n_nodes[0])
+    assert abs(n_ours - sk.tree_.node_count) <= 2
+
+
+def test_weight_masking_equals_subset_fit():
+    # Fitting with 0/1 weights must equal sklearn fit on the kept subset —
+    # this is the contract the fold/resampler masking relies on.
+    x, y = _data(300, seed=4)
+    keep = np.random.RandomState(0).rand(300) < 0.7
+    xt, _ = _data(500, seed=5)
+
+    sk = DecisionTreeClassifier(random_state=0).fit(x[keep], y[keep])
+    forest = _fit_dt(x, y, w=keep.astype(float))
+
+    # Tie-break noise applies here too (measured sklearn seed-to-seed
+    # agreement floor is ~0.95 on this family of datasets).
+    agree = (np.asarray(predict(forest, xt)) == sk.predict(xt)).mean()
+    assert agree >= 0.95
+
+
+@pytest.mark.parametrize("model,bootstrap,random_splits", [
+    (RandomForestClassifier, True, False),
+    (ExtraTreesClassifier, False, True),
+])
+def test_ensemble_f1_parity(model, bootstrap, random_splits):
+    # Ensembles have irreproducible internal RNG; parity target is the
+    # BASELINE.md criterion (F1 within tolerance), not identical trees.
+    x, y = _data(500, seed=6, signal=1.5)
+    xt, yt = _data(800, seed=7, signal=1.5)
+
+    sk = model(random_state=0, n_estimators=50).fit(x, y)
+    forest = fit_forest(
+        x, y, np.ones(len(y)), jax.random.PRNGKey(1), n_trees=50,
+        bootstrap=bootstrap, random_splits=random_splits, sqrt_features=True,
+    )
+
+    f1_sk = f1_score(yt, sk.predict(xt))
+    f1_us = f1_score(yt, np.asarray(predict(forest, xt)))
+    assert abs(f1_sk - f1_us) < 0.05, (f1_sk, f1_us)
+
+
+def test_proba_is_probability():
+    x, y = _data(200, seed=8)
+    forest = fit_forest(
+        x, y, np.ones(len(y)), jax.random.PRNGKey(2), n_trees=10,
+        bootstrap=True, random_splits=False, sqrt_features=True,
+    )
+    p = np.asarray(predict_proba(forest, x))
+    assert p.shape == (200, 2)
+    np.testing.assert_allclose(p.sum(1), 1.0, atol=1e-6)
+    assert (p >= 0).all()

@@ -1,0 +1,45 @@
+"""Regression tests for depth-cap and bootstrap edge cases found in review."""
+
+import numpy as np
+import jax
+
+from flake16_framework_tpu.ops.trees import (
+    fit_forest, predict, predict_proba, _bootstrap_weights
+)
+
+
+def test_depth_capped_children_have_values():
+    # Alternating labels on a single feature force splitting at every level;
+    # children created on the final level must still carry a distribution.
+    x = np.arange(200, dtype=float).reshape(-1, 1)
+    y = (np.arange(200) % 2).astype(bool)
+    f = fit_forest(
+        x, y, np.ones(200), jax.random.PRNGKey(0), n_trees=1, bootstrap=False,
+        random_splits=False, sqrt_features=False, max_depth=8,
+    )
+    p = np.asarray(predict_proba(f, x))
+    np.testing.assert_allclose(p.sum(1), 1.0, atol=1e-9)
+
+
+def test_predict_uses_fit_depth():
+    # Gini ties on alternating labels break to the leftmost boundary, so the
+    # exact tree is a depth-(N-1) caterpillar: full separation needs
+    # max_depth >= 63 here, and predict must honor the fit-time depth (a
+    # hardcoded traversal cap of 48 would truncate and misclassify).
+    x = np.arange(64, dtype=float).reshape(-1, 1)
+    y = (np.arange(64) % 2).astype(bool)
+    f = fit_forest(
+        x, y, np.ones(64), jax.random.PRNGKey(0), n_trees=1, bootstrap=False,
+        random_splits=False, sqrt_features=False, max_depth=70,
+    )
+    assert int(f.n_nodes[0]) == 127
+    np.testing.assert_array_equal(np.asarray(predict(f, x)), y)
+
+
+def test_bootstrap_never_selects_zero_weight_rows():
+    w = np.ones(50)
+    w[:25] = 0.0
+    for seed in range(20):
+        counts = np.asarray(_bootstrap_weights(w, jax.random.PRNGKey(seed)))
+        assert counts[:25].sum() == 0
+        assert counts[25:].sum() == 25  # exactly sum(w) draws
