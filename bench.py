@@ -1,0 +1,157 @@
+"""Benchmark: TPU sweep vs single-host sklearn on the probe configs.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): the reference publishes no numbers, so the baseline is
+self-measured — the same configs on the single-host CPU stack the reference
+uses (sklearn trees; the resampling steps use this repo's numpy oracles since
+imbalanced-learn is not installed here, matching imblearn 0.9 semantics).
+Ours: the jitted JAX sweep on the default backend (the real TPU chip under the
+driver; compile time excluded — the sweep reuses one compiled graph per model
+family, so per-config steady-state time is what scales to the 216-config grid).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_TESTS = int(os.environ.get("BENCH_N_TESTS", "2000"))
+SEED = 7
+
+# Probe configs (BASELINE.json "configs" №1-3 + family coverage).
+CONFIGS = [
+    ("NOD", "Flake16", "None", "None", "Decision Tree"),
+    ("NOD", "Flake16", "Scaling", "SMOTE", "Random Forest"),
+    ("OD", "Flake16", "PCA", "SMOTE Tomek", "Extra Trees"),
+    ("NOD", "Flake16", "Scaling", "ENN", "Extra Trees"),
+    ("OD", "Flake16", "None", "Tomek Links", "Decision Tree"),
+    ("OD", "FlakeFlagger", "Scaling", "SMOTE", "Random Forest"),
+]
+
+
+def sklearn_baseline(feats, labels_raw, configs):
+    """Single-host CPU reference pipeline per config (reference get_scores
+    semantics: full-data preprocess, stratified 10-fold, balance train only,
+    fit, predict)."""
+    import numpy as np
+    from sklearn.tree import DecisionTreeClassifier
+    from sklearn.ensemble import RandomForestClassifier, ExtraTreesClassifier
+    from sklearn.preprocessing import StandardScaler
+    from sklearn.decomposition import PCA
+    from sklearn.pipeline import Pipeline
+    from sklearn.model_selection import StratifiedKFold
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tests"))
+    from ref_resamplers import tomek_keep_ref, enn_keep_ref
+
+    from flake16_framework_tpu import config as cfg
+
+    rng = np.random.RandomState(0)
+
+    def balance(name, x, y):
+        if name == "None":
+            return x, y
+        if name in ("Tomek Links",):
+            keep = tomek_keep_ref(x, y, False)
+            return x[keep], y[keep]
+        if name == "ENN":
+            keep = enn_keep_ref(x, y, False)
+            return x[keep], y[keep]
+        # SMOTE-based: numpy SMOTE (imblearn 0.9 semantics)
+        minority = 1 if (y == 1).sum() < (y == 0).sum() else 0
+        x_min = x[y == minority]
+        n_min, n_maj = len(x_min), (y != minority).sum()
+        n_new = int(n_maj - n_min)
+        if n_new > 0 and n_min > 1:
+            d = ((x_min[:, None] - x_min[None]) ** 2).sum(-1)
+            np.fill_diagonal(d, np.inf)
+            k = min(5, n_min - 1)
+            nn = np.argsort(d, axis=1)[:, :k]
+            pick = rng.randint(0, n_min * k, n_new)
+            base, col = pick // k, pick % k
+            steps = rng.uniform(size=(n_new, 1))
+            x_new = x_min[base] + steps * (x_min[nn[base, col]] - x_min[base])
+            x = np.vstack([x, x_new])
+            y = np.concatenate([y, np.full(n_new, bool(minority))])
+        if name == "SMOTE Tomek":
+            keep = tomek_keep_ref(x, y, True)
+            return x[keep], y[keep]
+        if name == "SMOTE ENN":
+            keep = enn_keep_ref(x, y, True)
+            return x[keep], y[keep]
+        return x, y
+
+    models = {
+        "Decision Tree": lambda: DecisionTreeClassifier(random_state=0),
+        "Random Forest": lambda: RandomForestClassifier(random_state=0),
+        "Extra Trees": lambda: ExtraTreesClassifier(random_state=0),
+    }
+    preps = {
+        "None": None,
+        "Scaling": lambda: StandardScaler(),
+        "PCA": lambda: Pipeline([("s", StandardScaler()),
+                                 ("p", PCA(random_state=0))]),
+    }
+
+    t0 = time.time()
+    for keys in configs:
+        fl_name, fs_name, prep_name, bal_name, model_name = keys
+        fl = cfg.FLAKY_TYPES[fl_name]
+        cols = list(cfg.FEATURE_SETS[fs_name])
+        x = feats[:, cols]
+        y = labels_raw == fl
+        if preps[prep_name] is not None:
+            x = preps[prep_name]().fit_transform(x)
+        skf = StratifiedKFold(n_splits=10, shuffle=True, random_state=0)
+        for tr, te in skf.split(x, y):
+            xb, yb = balance(bal_name, x[tr], y[tr])
+            m = models[model_name]().fit(xb, yb)
+            m.predict(x[te])
+    return time.time() - t0
+
+
+def tpu_sweep(feats, labels_raw, projects, names, pids, configs):
+    from flake16_framework_tpu.parallel.sweep import SweepEngine
+
+    engine = SweepEngine(feats, labels_raw, projects, names, pids)
+    # Warm-up: compile each family graph once (steady-state measurement —
+    # one compile serves all configs of a family across the full 216 grid).
+    seen = set()
+    for keys in configs:
+        fam = (keys[1], keys[4])
+        if fam not in seen:
+            engine.run_config(keys)
+            seen.add(fam)
+
+    t0 = time.time()
+    for keys in configs:
+        engine.run_config(keys)
+    return time.time() - t0
+
+
+def main():
+    from flake16_framework_tpu.utils.synth import make_dataset
+
+    feats, labels, pids = make_dataset(n_tests=N_TESTS, seed=SEED)
+    names = [f"project{p:02d}" for p in range(26)]
+    projects = __import__("numpy").array([names[p] for p in pids])
+
+    t_base = sklearn_baseline(feats, labels, CONFIGS)
+    t_ours = tpu_sweep(feats, labels, projects, names, pids, CONFIGS)
+
+    speedup = t_base / t_ours if t_ours > 0 else float("inf")
+    print(json.dumps({
+        "metric": f"scores_probe_sweep_{len(CONFIGS)}cfg_n{N_TESTS}_speedup",
+        "value": round(speedup, 3),
+        "unit": "x_vs_single_host_sklearn",
+        "vs_baseline": round(speedup, 3),
+        "detail": {"t_sklearn_s": round(t_base, 2),
+                   "t_tpu_s": round(t_ours, 2)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""CLI — the reference's seven verbs (component 1,
+/root/reference/experiment.py:693-714), same names and stage contracts:
+
+    python -m flake16_framework_tpu setup       # provision subject venvs
+    python -m flake16_framework_tpu container NAME CMD...   # in-container
+    python -m flake16_framework_tpu run MODE... # docker collection campaign
+    python -m flake16_framework_tpu tests       # collate -> tests.json
+    python -m flake16_framework_tpu scores      # TPU sweep -> scores.pkl
+    python -m flake16_framework_tpu shap        # TPU Tree SHAP -> shap.pkl
+    python -m flake16_framework_tpu figures     # LaTeX artifacts
+
+Unknown/missing verbs raise ValueError like the reference.
+"""
+
+import sys
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        raise ValueError("No command given")
+
+    command, *args = argv
+
+    if command == "setup":
+        from flake16_framework_tpu.runner.containers import provision_all
+
+        provision_all()
+    elif command == "container":
+        from flake16_framework_tpu.runner.containers import container_entrypoint
+
+        container_entrypoint(*args)
+    elif command == "run":
+        from flake16_framework_tpu.runner.containers import run_experiment
+
+        run_experiment(args)
+    elif command == "tests":
+        from flake16_framework_tpu.runner.collate import write_tests
+
+        write_tests()
+    elif command == "scores":
+        from flake16_framework_tpu.pipeline import write_scores
+
+        write_scores()
+    elif command == "shap":
+        from flake16_framework_tpu.pipeline import write_shap
+
+        write_shap()
+    elif command == "figures":
+        from flake16_framework_tpu.figures.report import write_figures
+
+        write_figures()
+    else:
+        raise ValueError("Unrecognized command given")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Pairwise-distance / k-nearest-neighbour primitive.
+
+One kernel feeds every resampler (SURVEY.md §7 step 5): squared Euclidean
+distances via the matmul identity |a-b|^2 = |a|^2 + |b|^2 - 2ab — the 2ab term
+is an [N,F]x[F,N] matmul that XLA tiles onto the MXU, which is exactly where
+this work belongs on TPU (the reference does it in sklearn's Cython brute-force
+kNN, /root/reference SURVEY §2 table B).
+
+Masking convention: invalid columns (rows that are not candidate neighbours)
+get +inf distance; the diagonal (self) is always +inf, matching sklearn's
+NearestNeighbors(n_neighbors=k+1)[:, 1:] self-exclusion.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def pairwise_sq_dists(a, b):
+    """[Na, F], [Nb, F] -> [Na, Nb] squared Euclidean distances (MXU matmul)."""
+    aa = jnp.sum(a * a, axis=1)
+    bb = jnp.sum(b * b, axis=1)
+    d = aa[:, None] + bb[None, :] - 2.0 * (a @ b.T)
+    return jnp.maximum(d, 0.0)
+
+
+def masked_knn(x, col_valid, k):
+    """k nearest valid neighbours of every row (self excluded).
+
+    Returns (idx [N, k] int32, ok [N, k] bool) — ok marks neighbours that are
+    real (valid column, not +inf padding). Ties resolve to the lowest index
+    (lax.top_k is stable), matching brute-force sklearn ordering.
+    """
+    n = x.shape[0]
+    d = pairwise_sq_dists(x, x)
+    d = jnp.where(col_valid[None, :], d, jnp.inf)
+    d = d.at[jnp.arange(n), jnp.arange(n)].set(jnp.inf)
+    neg, idx = lax.top_k(-d, k)
+    return idx.astype(jnp.int32), jnp.isfinite(neg)
+
+
+def nearest_one(x, col_valid):
+    """Index of the single nearest valid neighbour per row (ties -> lowest)."""
+    n = x.shape[0]
+    d = pairwise_sq_dists(x, x)
+    d = jnp.where(col_valid[None, :], d, jnp.inf)
+    d = d.at[jnp.arange(n), jnp.arange(n)].set(jnp.inf)
+    return jnp.argmin(d, axis=1).astype(jnp.int32)

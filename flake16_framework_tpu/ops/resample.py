@@ -1,0 +1,159 @@
+"""The five balancing kernels + runtime dispatch.
+
+Reference grid axis (/root/reference/experiment.py:87-94): {None, TomekLinks,
+SMOTE, ENN, SMOTE-ENN, SMOTE-Tomek}, imbalanced-learn 0.9.0 defaults. Their
+semantics (re-derived, not copied — imblearn is unavailable here):
+
+- TomekLinks: sample i is in a Tomek link iff its 1-NN j has a different class
+  and j's 1-NN is i. 'auto' removes only non-minority link members; 'all'
+  (the variant used inside SMOTETomek) removes every link member.
+- ENN (n_neighbors=3, kind_sel='all'): a target-class sample is kept iff all 3
+  of its nearest neighbours share its class. 'auto' cleans the majority class,
+  'all' (inside SMOTEENN) cleans both.
+- SMOTE (k_neighbors=5, 'auto'): synthesize n_maj - n_min minority samples;
+  each is base + U(0,1) * (neighbour - base) with the neighbour drawn uniformly
+  from the base's 5-NN within the minority class.
+- SMOTEENN / SMOTETomek: SMOTE then the cleaner with sampling_strategy='all'.
+
+TPU-first shape discipline (SURVEY.md §7 step 5 "hard part"): resampled sets
+have data-dependent sizes, so every kernel returns fixed-capacity arrays
+(x [cap,F], y [cap], w [cap]) where w is a 0/1 validity weight consumed
+directly by the tree fitters' weight masking — dynamic shapes never exist.
+The balancing axis is a runtime int dispatched with lax.switch, so one
+compiled sweep graph covers all six settings.
+
+RNG note: imblearn draws from numpy RandomState(0); we use jax PRNG. Resampled
+draws are not bit-identical, parity is at the F1 level (BASELINE.md criterion).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from flake16_framework_tpu.ops.knn import masked_knn, nearest_one
+
+SMOTE_K = 5
+ENN_K = 3
+
+
+def _class_counts(y, w):
+    pos = jnp.sum(jnp.where(y, w, 0.0))
+    neg = jnp.sum(w) - pos
+    return neg, pos
+
+
+def _pad_cap(x, y, w, cap):
+    n, f = x.shape
+    pad = cap - n
+    x_out = jnp.concatenate([x, jnp.zeros((pad, f), x.dtype)])
+    y_out = jnp.concatenate([y, jnp.zeros((pad,), y.dtype)])
+    w_out = jnp.concatenate([w, jnp.zeros((pad,), w.dtype)])
+    return x_out, y_out, w_out
+
+
+def tomek_keep(x, y, w, *, strategy_all):
+    """0/1 keep mask implementing TomekLinks under-sampling."""
+    valid = w > 0
+    nn1 = nearest_one(x, valid)
+    mutual = nn1[nn1] == jnp.arange(x.shape[0])
+    diff = y[nn1] != y
+    link = valid & diff & mutual
+
+    if not strategy_all:
+        neg, pos = _class_counts(y, w)
+        majority_is_pos = pos >= neg
+        link = link & (y == majority_is_pos)
+
+    return jnp.where(valid & ~link, w, 0.0)
+
+
+def enn_keep(x, y, w, *, strategy_all):
+    """0/1 keep mask implementing EditedNearestNeighbours(kind_sel='all')."""
+    valid = w > 0
+    idx, ok = masked_knn(x, valid, ENN_K)
+    # Missing neighbours (tiny classes) count as agreeing, i.e. never remove.
+    same = (y[idx] == y[:, None]) | ~ok
+    all_same = jnp.all(same, axis=1)
+
+    target = valid
+    if not strategy_all:
+        neg, pos = _class_counts(y, w)
+        majority_is_pos = pos >= neg
+        target = target & (y == majority_is_pos)
+
+    remove = target & ~all_same
+    return jnp.where(valid & ~remove, w, 0.0)
+
+
+def smote(x, y, w, key, cap):
+    """SMOTE oversampling into fixed capacity: rows [0,N) are the originals,
+    rows [N,cap) are synthetic slots, the first n_maj-n_min of which are valid."""
+    n, f = x.shape
+    neg, pos = _class_counts(y, w)
+    minority_is_pos = pos < neg
+    is_min = (w > 0) & (y == minority_is_pos)
+    n_min = jnp.sum(is_min.astype(jnp.int32))
+    n_maj = jnp.sum((w > 0).astype(jnp.int32)) - n_min
+    # No minority samples in this fold: imblearn would raise; the masked
+    # equivalent is a no-op (synthesizing from majority rows would poison
+    # the training set with mislabeled copies).
+    n_synth = jnp.where(
+        n_min > 0, jnp.clip(n_maj - n_min, 0, cap - n), 0
+    )
+
+    idx, ok = masked_knn(x, is_min, SMOTE_K)
+
+    # Minority rows in original order (stable argsort moves them to the front).
+    min_order = jnp.argsort(~is_min, stable=True).astype(jnp.int32)
+
+    n_slots = cap - n
+    ki, ks = jax.random.split(key)
+    # imblearn draw: one randint over the flattened [n_min x k] neighbour table.
+    pick = jax.random.randint(
+        ki, (n_slots,), 0, jnp.maximum(n_min * SMOTE_K, 1)
+    )
+    base = min_order[pick // SMOTE_K]
+    col = pick % SMOTE_K
+    nbr = idx[base, col]
+    nbr = jnp.where(ok[base, col], nbr, base)  # degenerate tiny-minority guard
+
+    steps = jax.random.uniform(ks, (n_slots, 1), dtype=x.dtype)
+    x_new = x[base] + steps * (x[nbr] - x[base])
+    slot_ok = jnp.arange(n_slots) < n_synth
+
+    x_out = jnp.concatenate([x, jnp.where(slot_ok[:, None], x_new, 0.0)])
+    y_out = jnp.concatenate([y, jnp.full((n_slots,), minority_is_pos, y.dtype)])
+    w_out = jnp.concatenate([w, slot_ok.astype(w.dtype)])
+    return x_out, y_out, w_out
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def resample(x, y, w, bal_code, key, cap):
+    """Dispatch on the balancing code (config.BALANCINGS). Returns
+    (x [cap,F], y [cap], w [cap]); w folds validity into sample weight."""
+
+    def none_():
+        return _pad_cap(x, y, w, cap)
+
+    def tomek_():
+        return _pad_cap(x, y, tomek_keep(x, y, w, strategy_all=False), cap)
+
+    def smote_():
+        return smote(x, y, w, key, cap)
+
+    def enn_():
+        return _pad_cap(x, y, enn_keep(x, y, w, strategy_all=False), cap)
+
+    def smote_enn_():
+        xs, ys, ws = smote(x, y, w, key, cap)
+        return xs, ys, enn_keep(xs, ys, ws, strategy_all=True)
+
+    def smote_tomek_():
+        xs, ys, ws = smote(x, y, w, key, cap)
+        return xs, ys, tomek_keep(xs, ys, ws, strategy_all=True)
+
+    return lax.switch(
+        bal_code, (none_, tomek_, smote_, enn_, smote_enn_, smote_tomek_)
+    )

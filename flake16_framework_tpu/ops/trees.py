@@ -96,7 +96,7 @@ def _select_features(nc, key, max_features):
     return (r <= kth) & nc
 
 
-def _best_exact_splits(sample_node, w, wy, order0, xsorted, x, tot_w, tot_wy,
+def _best_exact_splits(sample_node, w, wy, order0, xsorted, tot_w, tot_wy,
                        max_nodes):
     """Exact best-split search over all features for all current nodes.
 
@@ -238,7 +238,7 @@ def _fit_one_tree(x, y01, w, key, order0, xsorted, *, random_splits,
             )
         else:
             score, thr, nc = _best_exact_splits(
-                sample_node, w, wy, order0, xsorted, x, tot_w, tot_wy, m
+                sample_node, w, wy, order0, xsorted, tot_w, tot_wy, m
             )
 
         sel = _select_features(nc.T, kf, max_features)  # [M1, F]
@@ -288,7 +288,7 @@ def _fit_one_tree(x, y01, w, key, order0, xsorted, *, random_splits,
     return feature, threshold, left, right, value, n_nodes
 
 
-def _bootstrap_weights(w, key, n_draws_hint=None):
+def _bootstrap_weights(w, key):
     """Multinomial bootstrap over rows with positive weight (sklearn RF draws
     n_train samples with replacement; here n_train = round(sum(w))). Inverse-CDF
     sampling keeps memory at O(N), not O(N^2) like gumbel-categorical."""

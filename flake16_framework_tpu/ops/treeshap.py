@@ -1,0 +1,263 @@
+"""Path-dependent Tree SHAP as pure JAX (reference: shap.TreeExplainer's C
+extension, feature_perturbation='tree_path_dependent', called at
+/root/reference/experiment.py:517; SURVEY.md §2 table B).
+
+Formulation: instead of the reference's sequential recursive EXTEND/UNWIND
+walk, we use the leaf-parallel decomposition (the GPUTreeShap insight — see
+PAPERS.md): each (leaf, sample) pair contributes independently. For a leaf's
+root path, duplicate features merge multiplicatively into per-feature
+(zero_fraction z_f, one_fraction o_f) with at most F unique entries; the
+Shapley permutation weights come from one EXTEND polynomial pass over the F
+feature slots and one UNWIND per present feature — O(F^2) per (leaf, sample),
+F = 16. Leaves and samples ride vmap axes; trees are summed with lax.map so
+only one tree's workspace is live at a time. This maps to the TPU VPU as large
+elementwise/scan batches instead of pointer-chasing recursion.
+
+Output convention matches the reference exactly: ``shap_values(X)[0]`` —
+contributions to the *class-0 probability* of the soft-vote ensemble, an
+[S, F] array (experiment.py:517 takes element [0] of the per-class list).
+
+Local accuracy (sum_f phi_f(x) = p0(x) - E[p0]) is the built-in invariant the
+tests enforce, alongside a brute-force subset-enumeration oracle on tiny trees.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def extract_paths(feature, threshold, left, right, value, max_depth):
+    """Tree arrays [M] -> per-leaf padded root-path steps.
+
+    Returns dict with [L, D] step arrays (L = M//2+1 leaf slots, D = max_depth):
+      sf: split feature of the ancestor; sthr: its threshold; sratio:
+      cover(child)/cover(ancestor) for the path's child; sleft: whether the
+      path goes left; svalid: step exists. Plus leaf_p0 [L] (class-0 prob),
+      leaf_ok [L], leaf_cover_frac [L] (cover/root cover).
+    Steps are ordered leaf -> root; order is irrelevant to the symmetric
+    EXTEND polynomial.
+    """
+    m = feature.shape[0]
+    d_max = max_depth
+    cover = value.sum(-1)
+
+    idx = jnp.arange(m)
+    parent_buf = jnp.full((m + 1,), -1, jnp.int32)
+    parent = parent_buf.at[jnp.where(left >= 0, left, m)].set(
+        jnp.where(left >= 0, idx, -1).astype(jnp.int32)
+    )
+    parent = parent.at[jnp.where(right >= 0, right, m)].set(
+        jnp.where(right >= 0, idx, -1).astype(jnp.int32)
+    )
+    parent = parent[:m]
+
+    is_leaf = (feature < 0) & (cover > 0)
+    n_slots = m // 2 + 1
+    leaf_ids = jnp.argsort(~is_leaf, stable=True)[:n_slots].astype(jnp.int32)
+    leaf_ok = is_leaf[leaf_ids]
+
+    def walk(leaf):
+        def step(carry, _):
+            node = carry
+            p = parent[node]
+            ok = p >= 0
+            psafe = jnp.maximum(p, 0)
+            rec = (
+                jnp.where(ok, feature[psafe], 0).astype(jnp.int32),
+                jnp.where(ok, threshold[psafe], 0.0),
+                jnp.where(ok, cover[node] / jnp.maximum(cover[psafe], 1e-30),
+                          1.0),
+                ok & (left[psafe] == node),
+                ok,
+            )
+            return jnp.where(ok, psafe, node), rec
+
+        _, recs = lax.scan(step, leaf, None, length=d_max)
+        return recs
+
+    sf, sthr, sratio, sleft, svalid = jax.vmap(walk)(leaf_ids)
+
+    v0 = value[leaf_ids, 0]
+    tot = jnp.maximum(value[leaf_ids].sum(-1), 1e-30)
+    root_cover = jnp.maximum(cover[0], 1e-30)
+
+    return {
+        "sf": sf, "sthr": sthr, "sratio": sratio, "sleft": sleft,
+        "svalid": svalid, "leaf_p0": v0 / tot, "leaf_ok": leaf_ok,
+        "leaf_cover_frac": cover[leaf_ids] / root_cover,
+    }
+
+
+def _merge_path_features(paths, x, n_features):
+    """Per (leaf, feature): presence, merged zero fraction z (product of cover
+    ratios), and per-sample one fraction o (AND of branch indicators).
+
+    Returns present [L, F], z [L, F], o [L, S, F].
+    """
+    sf, sratio, sthr, sleft, svalid = (
+        paths["sf"], paths["sratio"], paths["sthr"], paths["sleft"],
+        paths["svalid"],
+    )
+    l, d = sf.shape
+    onehot = (sf[:, :, None] == jnp.arange(n_features)[None, None, :]) & (
+        svalid[:, :, None]
+    )  # [L, D, F]
+    present = onehot.any(axis=1)
+    z = jnp.prod(jnp.where(onehot, sratio[:, :, None], 1.0), axis=1)
+
+    def sample_o(xs):  # xs: [F] one sample
+        goes_left = xs[sf] <= sthr  # [L, D]
+        ind = jnp.where(sleft, goes_left, ~goes_left)
+        sat = jnp.where(onehot, ind[:, :, None], True)
+        return jnp.all(sat, axis=1)  # [L, F]
+
+    o = jax.vmap(sample_o, in_axes=0, out_axes=1)(x)  # [L, S, F]
+    return present, z, o.astype(z.dtype)
+
+
+def _extend_all(present, z, o, n_features):
+    """Run the EXTEND polynomial over all (up to F) unique path features.
+
+    present/z/o: [..., F]. Returns (w [..., F+2], l [...]) — the permutation
+    weight vector and final path length (dummy element included).
+    """
+    shape = present.shape[:-1]
+    f2 = n_features + 2
+    i = jnp.arange(f2)
+
+    w0 = jnp.zeros((*shape, f2), z.dtype).at[..., 0].set(1.0)
+    l0 = jnp.ones(shape, z.dtype)  # dummy element counts 1
+
+    def ext(carry, f):
+        w, l = carry
+        zf = z[..., f][..., None]
+        of = o[..., f][..., None]
+        pf = present[..., f]
+        ln = l[..., None]
+        # Functional form of the in-place EXTEND recurrence: position i keeps
+        # z*w[i]*(l-i)/(l+1) and gains o*w[i-1]*i/(l+1) from below.
+        stay = zf * w * (ln - i) / (ln + 1.0)
+        up = of * jnp.concatenate(
+            [jnp.zeros_like(w[..., :1]), w[..., :-1]], axis=-1
+        ) * i / (ln + 1.0)
+        w = jnp.where(pf[..., None], stay + up, w)
+        l = l + pf.astype(l.dtype)
+        return (w, l), None
+
+    (w, l), _ = lax.scan(ext, (w0, l0), jnp.arange(n_features))
+    return w, l
+
+
+def _unwound_sum(w, l, z, o):
+    """Sum of the path weights after UNWINDing one feature with fractions
+    (z, o) — the inner loop of Tree SHAP's leaf accumulation, vectorized over
+    the weight axis being implicit (runs the sequential recurrence over F+1
+    positions).
+
+    w: [..., F+2]; l: [...] path length (count incl. dummy); z,o: [...].
+    """
+    f2 = w.shape[-1]
+
+    def step(carry, j):
+        # iterate positions j = l-2 .. 0: run j over the static range high to
+        # low, masking positions >= l-1.
+        total, nxt = carry
+        lm1 = l - 1.0
+        active = (j <= lm1 - 1.0) & (lm1 > 0)
+        wj = jnp.take(w, j.astype(jnp.int32), axis=-1)
+        # o != 0 branch
+        tmp = nxt * l / ((j + 1.0) * jnp.where(o == 0, 1.0, o))
+        total_o = total + tmp
+        nxt_o = wj - tmp * z * (lm1 - j) / l
+        # o == 0 branch
+        total_z = total + wj * l / (z * (lm1 - j))
+        tot_new = jnp.where(o == 0, total_z, total_o)
+        nxt_new = jnp.where(o == 0, nxt, nxt_o)
+        total = jnp.where(active, tot_new, total)
+        nxt = jnp.where(active, nxt_new, nxt)
+        return (total, nxt), None
+
+    # nxt starts at w[l-1]
+    li = (l - 1.0).astype(jnp.int32)[..., None]
+    nxt0 = jnp.take_along_axis(w, li, axis=-1)[..., 0]
+    total0 = jnp.zeros_like(nxt0)
+    js = jnp.arange(f2 - 2, -1, -1).astype(w.dtype)
+    (total, _), _ = lax.scan(step, (total0, nxt0), js)
+    return total
+
+
+@functools.partial(jax.jit, static_argnames=("n_features",))
+def tree_shap_single(paths, x, n_features):
+    """phi [S, F] for one tree's class-0 leaf values."""
+    present, z, o = _merge_path_features(paths, x, n_features)
+    # broadcast z/present over samples: [L, S, F]
+    zs = jnp.broadcast_to(z[:, None, :], o.shape)
+    ps = jnp.broadcast_to(present[:, None, :], o.shape)
+
+    w, l = _extend_all(ps, zs, o, n_features)  # [L, S, F+2], [L, S]
+
+    def per_feature(f):
+        u = _unwound_sum(w, l, zs[..., f], o[..., f])  # [L, S]
+        phi_f = (o[..., f] - zs[..., f]) * u
+        return jnp.where(ps[..., f], phi_f, 0.0)
+
+    phi = jax.vmap(per_feature)(jnp.arange(n_features))  # [F, L, S]
+    leaf_scale = jnp.where(paths["leaf_ok"], paths["leaf_p0"], 0.0)
+    phi = jnp.einsum("fls,l->sf", phi, leaf_scale)
+    return phi
+
+
+def forest_shap_class0(forest, x, *, sample_chunk=None):
+    """Mean over trees of per-tree class-0 Tree SHAP — the ensemble
+    soft-vote's probability decomposition (what shap_values(X)[0] returns for
+    a sklearn forest).
+
+    forest: trees.Forest with [T, ...] axes. Trees run under lax.map so only
+    one tree's O(L*S*F) workspace is live; chunk samples via ``sample_chunk``
+    if even that is too large.
+    """
+    n_features = x.shape[1]
+    t = forest.feature.shape[0]
+    depth = int(forest.max_depth)
+
+    def one_tree(args):
+        fe, th, le, ri, va = args
+        paths = extract_paths(fe, th, le, ri, va, depth)
+        if sample_chunk is None:
+            return tree_shap_single(paths, x, n_features)
+        n = x.shape[0]
+        pads = (-n) % sample_chunk
+        xp = jnp.pad(x, ((0, pads), (0, 0)))
+        chunks = xp.reshape(-1, sample_chunk, n_features)
+        out = lax.map(
+            lambda c: tree_shap_single(paths, c, n_features), chunks
+        )
+        return out.reshape(-1, n_features)[:n]
+
+    phis = lax.map(
+        one_tree,
+        (forest.feature, forest.threshold, forest.left, forest.right,
+         forest.value),
+    )
+    return jnp.mean(phis, axis=0)
+
+
+def expected_p0(forest):
+    """Base value E[p0] under path-dependent cover weighting, per tree then
+    averaged — pairs with forest_shap_class0 for the local-accuracy check."""
+    def one(args):
+        fe, th, le, ri, va = args
+        paths = extract_paths(fe, th, le, ri, va, int(forest.max_depth))
+        return jnp.sum(
+            jnp.where(paths["leaf_ok"],
+                      paths["leaf_p0"] * paths["leaf_cover_frac"], 0.0)
+        )
+
+    vals = lax.map(
+        one,
+        (forest.feature, forest.threshold, forest.left, forest.right,
+         forest.value),
+    )
+    return jnp.mean(vals)

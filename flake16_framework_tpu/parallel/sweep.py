@@ -1,0 +1,270 @@
+"""The 216-config x 10-fold sweep as jitted JAX over a device mesh.
+
+Reference shape (/root/reference/experiment.py:446-501): a process pool forks
+``get_scores`` per config; each config runs 10-fold stratified CV with
+preprocess -> balance -> fit -> predict -> confusion accumulation. Here the
+same pipeline is a pure function of arrays:
+
+- Within a config, the 10 folds ride one ``vmap`` axis (fold membership is a
+  0/1 weight mask, so all folds share shapes — parallel/folds.py).
+- Configs are grouped into 6 model families (feature-set x model = the axes
+  that change shapes/compiled code). Within a family, flaky type,
+  preprocessing, and balancing are *runtime data* (int codes), so one compiled
+  graph per family covers all 36 of its configs.
+- Across devices, a batch of configs is laid out on a ``Mesh`` axis named
+  "config" with ``shard_map`` — the TPU-native analog of the reference's
+  process fan-out (SURVEY.md §2C: config-axis data parallelism over ICI).
+  Score counts are tiny [P,3] int arrays; only those return to host.
+
+Fit and predict run as two jitted stages so the reference's per-config
+T_TRAIN/T_TEST timing fields (experiment.py:468-474) stay measurable.
+"""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flake16_framework_tpu import config as cfg
+from flake16_framework_tpu.ops.metrics import confusion_by_project, format_scores
+from flake16_framework_tpu.ops.preprocess import fit_preprocess, transform
+from flake16_framework_tpu.ops.resample import resample
+from flake16_framework_tpu.ops import trees
+from flake16_framework_tpu.parallel.folds import fold_masks
+
+N_FOLDS = 10
+
+
+def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
+                n_folds=N_FOLDS):
+    """Build (cv_fit, cv_score) jitted for one model family.
+
+    cv_fit(x, y_raw, flaky_label, prep_code, bal_code, key, train_mask)
+        -> (forest stacked over folds, xp, y)
+    cv_score(forest, xp, y, test_mask, project_ids) -> counts [P, 3]
+
+    All config axes inside a family are traced ints; shapes depend only on
+    (n, n_feat, spec) so each family compiles exactly once.
+    """
+    if cap is None:
+        cap = 2 * n  # SMOTE at worst doubles the training set
+    max_nodes = 2 * cap
+
+    def _fit_one_fold(xp, y, bal_code, fold_key, w_train):
+        kb, kf = jax.random.split(fold_key)
+        xs, ys, ws = resample(xp, y, w_train, bal_code, kb, cap)
+        return trees.fit_forest(
+            xs, ys, ws, kf, n_trees=spec.n_trees, bootstrap=spec.bootstrap,
+            random_splits=spec.random_splits, sqrt_features=spec.sqrt_features,
+            max_depth=max_depth, max_nodes=max_nodes,
+        )
+
+    @jax.jit
+    def cv_fit(x, y_raw, flaky_label, prep_code, bal_code, key, train_mask):
+        y = y_raw == flaky_label
+        mu, wmat = fit_preprocess(x, prep_code)
+        xp = transform(x, mu, wmat)
+        fold_keys = jax.random.split(key, n_folds)
+        forest = jax.vmap(
+            lambda k, w: _fit_one_fold(xp, y, bal_code, k, w)
+        )(fold_keys, train_mask)
+        return forest, xp, y
+
+    @jax.jit
+    def cv_score(forest, xp, y, test_mask, project_ids):
+        preds = jax.vmap(lambda f: trees.predict(f, xp))(forest)
+        return confusion_by_project(
+            y, preds, test_mask, project_ids, n_projects
+        )
+
+    return cv_fit, cv_score
+
+
+def _family_configs(fs_name, model_name):
+    """The 36 config key-tuples of one (feature-set, model) family, in
+    reference sweep order."""
+    out = []
+    for keys in cfg.iter_config_keys():
+        if keys[1] == fs_name and keys[4] == model_name:
+            out.append(keys)
+    return out
+
+
+class SweepEngine:
+    """Host driver for the full grid (reference write_scores,
+    experiment.py:493-501), laying config batches on a device mesh.
+
+    Also provides the per-config ledger the reference lacks (SURVEY.md §5
+    checkpoint/resume: "a killed scores sweep restarts all 216 configs"):
+    ``run_grid(ledger=...)`` skips configs already present.
+    """
+
+    def __init__(self, features, labels_raw, projects, project_names,
+                 project_ids, *, mesh=None, max_depth=48, seed=0,
+                 n_folds=N_FOLDS, tree_overrides=None):
+        self.features = np.asarray(features, dtype=np.float32)
+        self.labels_raw = np.asarray(labels_raw, dtype=np.int32)
+        self.projects = projects
+        self.project_names = project_names
+        self.project_ids = np.asarray(project_ids, dtype=np.int32)
+        self.mesh = mesh
+        self.max_depth = max_depth
+        self.seed = seed
+        self.n_folds = n_folds
+        # tests shrink ensembles: {"Random Forest": 10, ...}
+        self.tree_overrides = tree_overrides or {}
+        self._fns = {}
+        # Fold masks depend on the label vector => per flaky type
+        # (reference re-splits per config, experiment.py:449-450; identical
+        # within a flaky type).
+        self._masks = {}
+        for fl_name, fl in cfg.FLAKY_TYPES.items():
+            self._masks[fl_name] = fold_masks(
+                self.labels_raw == fl, n_splits=n_folds, seed=0
+            )
+
+    def _spec(self, model_name):
+        spec = cfg.MODELS[model_name]
+        if model_name in self.tree_overrides:
+            spec = type(spec)(
+                spec.name, self.tree_overrides[model_name], spec.bootstrap,
+                spec.random_splits, spec.sqrt_features,
+            )
+        return spec
+
+    def _get_fns(self, fs_name, model_name):
+        key = (fs_name, model_name)
+        if key not in self._fns:
+            n, _ = self.features.shape
+            cols = list(cfg.FEATURE_SETS[fs_name])
+            self._fns[key] = (
+                make_cv_fns(
+                    self._spec(model_name), n=n, n_feat=len(cols),
+                    n_projects=len(self.project_names),
+                    max_depth=self.max_depth, n_folds=self.n_folds,
+                ),
+                cols,
+            )
+        return self._fns[key]
+
+    def run_config(self, config_keys):
+        """Run one config; returns (t_train, t_test, scores, scores_total)
+        in the reference scores.pkl value schema (README.rst:78-134)."""
+        fl_name, fs_name, prep_name, bal_name, model_name = config_keys
+        (cv_fit, cv_score), cols = self._get_fns(fs_name, model_name)
+
+        x = jnp.asarray(self.features[:, cols])
+        train_mask, test_mask = self._masks[fl_name]
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self.seed),
+            list(cfg.iter_config_keys()).index(tuple(config_keys)),
+        )
+
+        t0 = time.time()
+        forest, xp, y = cv_fit(
+            x, jnp.asarray(self.labels_raw),
+            jnp.int32(cfg.FLAKY_TYPES[fl_name]),
+            jnp.int32(cfg.PREPROCESSINGS[prep_name]),
+            jnp.int32(cfg.BALANCINGS[bal_name]),
+            key, jnp.asarray(train_mask),
+        )
+        jax.block_until_ready(forest)
+        t_train = time.time() - t0
+
+        t0 = time.time()
+        counts = cv_score(
+            forest, xp, y, jnp.asarray(test_mask),
+            jnp.asarray(self.project_ids),
+        )
+        counts = np.asarray(counts)
+        t_test = time.time() - t0
+
+        scores, scores_total = format_scores(
+            counts, self.project_names, self.projects
+        )
+        return [t_train / self.n_folds, t_test / self.n_folds, scores,
+                scores_total]
+
+    def run_grid(self, config_list=None, ledger=None, progress=None):
+        """Run many configs; returns {config_keys: [t_train, t_test, scores,
+        scores_total]}. ``ledger`` is a dict of already-done configs to skip
+        (per-config resume, unlike the reference). ``progress`` receives
+        (i, total, keys, live_scores) after each config — live_scores is the
+        accumulating dict, so callers can checkpoint it mid-sweep."""
+        scores = dict(ledger or {})
+        if config_list is None:
+            config_list = cfg.iter_config_keys()
+        todo = [k for k in config_list if tuple(k) not in scores]
+        for i, keys in enumerate(todo):
+            scores[tuple(keys)] = self.run_config(keys)
+            if progress is not None:
+                progress(i + 1, len(todo), keys, scores)
+        return scores
+
+
+def make_sharded_family_fn(spec, mesh, *, n, n_feat, n_projects,
+                           max_depth=48, n_folds=N_FOLDS):
+    """Config-batched CV over a mesh axis "config" — one device per config
+    shard, the ICI analog of the reference's process pool.
+
+    Returns fn(x, y_raw, flaky_labels [B], prep_codes [B], bal_codes [B],
+    keys [B,2], train_masks [B,folds,N], test_masks [B,folds,N],
+    project_ids) -> counts [B, P, 3], with B a multiple of the mesh's
+    "config" axis size. The data arrays are replicated; only the config axis
+    is split, so the only cross-device traffic is the parameter scatter and
+    the tiny counts gather.
+    """
+    cap = 2 * n
+    max_nodes = 2 * cap
+
+    def one_config(x, y_raw, fl, prep, bal, key, train_mask, test_mask,
+                   project_ids):
+        y = y_raw == fl
+        mu, wmat = fit_preprocess(x, prep)
+        xp = transform(x, mu, wmat)
+        fold_keys = jax.random.split(key, n_folds)
+
+        def fold(k, w_train):
+            kb, kf = jax.random.split(k)
+            xs, ys, ws = resample(xp, y, w_train, bal, kb, cap)
+            forest = trees.fit_forest(
+                xs, ys, ws, kf, n_trees=spec.n_trees,
+                bootstrap=spec.bootstrap, random_splits=spec.random_splits,
+                sqrt_features=spec.sqrt_features, max_depth=max_depth,
+                max_nodes=max_nodes,
+            )
+            return trees.predict(forest, xp)
+
+        preds = jax.vmap(fold)(fold_keys, train_mask)
+        return confusion_by_project(y, preds, test_mask, project_ids,
+                                    n_projects)
+
+    def batched(x, y_raw, fls, preps, bals, keys, train_masks, test_masks,
+                project_ids):
+        return jax.vmap(
+            lambda fl, prep, bal, key, trm, tem: one_config(
+                x, y_raw, fl, prep, bal, key, trm, tem, project_ids
+            )
+        )(fls, preps, bals, keys, train_masks, test_masks)
+
+    pspec = P("config")
+    return jax.jit(
+        jax.shard_map(
+            batched, mesh=mesh,
+            in_specs=(P(), P(), pspec, pspec, pspec, pspec, pspec, pspec,
+                      P()),
+            out_specs=pspec,
+            # Replicated data arrays mix with config-varying codes inside
+            # lax.switch; jax 0.9's varying-manual-axes validator rejects
+            # that conservatively (its own error message says to disable).
+            check_vma=False,
+        )
+    )
+
+
+def default_mesh(axis="config"):
+    """1-D mesh over all local devices."""
+    return Mesh(np.array(jax.devices()), (axis,))

@@ -1,0 +1,114 @@
+"""Stage drivers: the ``scores`` and ``shap`` verbs (reference
+/root/reference/experiment.py:493-530), artifact-compatible pickles.
+
+Differences from the reference, by design:
+- Device mesh instead of a process pool (SURVEY.md §5 "distributed backend").
+- Per-config checkpoint ledger: a partial scores.pkl is reloaded and completed
+  configs skipped — the reference restarts all 216 on a crash (SURVEY.md §5).
+- The no-balancing SHAP branch works (the reference's has a latent NameError,
+  experiment.py:515 — fixed, not reproduced; SURVEY.md §2 row 17).
+"""
+
+import os
+import pickle
+import sys
+import time
+
+import jax
+import numpy as np
+
+from flake16_framework_tpu import config as cfg
+from flake16_framework_tpu.constants import SCORES_FILE, SHAP_FILE, TESTS_FILE
+from flake16_framework_tpu.data import load_tests, tests_to_arrays
+from flake16_framework_tpu.ops import trees, treeshap
+from flake16_framework_tpu.ops.preprocess import fit_preprocess, transform
+from flake16_framework_tpu.ops.resample import resample
+from flake16_framework_tpu.parallel.sweep import SweepEngine
+
+
+def _load_arrays(tests_file):
+    return tests_to_arrays(load_tests(tests_file))
+
+
+def write_scores(tests_file=TESTS_FILE, out_file=SCORES_FILE, *,
+                 max_depth=48, tree_overrides=None, configs=None,
+                 checkpoint_every=12, progress_out=sys.stdout):
+    """Run the (216-config x 10-fold) sweep and pickle the reference-schema
+    scores dict. Resumes from an existing partial ``out_file``."""
+    feats, labels, projects, names, pids = _load_arrays(tests_file)
+    engine = SweepEngine(
+        feats, labels, projects, names, pids, max_depth=max_depth,
+        tree_overrides=tree_overrides,
+    )
+
+    ledger = {}
+    if os.path.exists(out_file):
+        with open(out_file, "rb") as fd:
+            ledger = pickle.load(fd)
+
+    t0 = time.time()
+
+    def progress(i, total, keys, live_scores):
+        el = time.time() - t0
+        progress_out.write(
+            f"[{i}/{total}] {', '.join(keys)} ({el:.1f}s elapsed)\n"
+        )
+        if i % checkpoint_every == 0:
+            _dump(live_scores, out_file)
+
+    scores_all = engine.run_grid(configs, ledger=ledger, progress=progress)
+    _dump(scores_all, out_file)
+    return scores_all
+
+
+def _dump(obj, path):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fd:
+        pickle.dump(obj, fd)
+    os.replace(tmp, path)
+
+
+def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
+                    tree_overrides=None, seed=0, sample_chunk=512):
+    """One SHAP config (reference get_shap experiment.py:504-517): preprocess
+    full data, fit on the balanced full set, explain every original sample.
+    Returns the class-0 values array [N, F'] (the reference's
+    ``shap_values(features)[0]`` convention)."""
+    fl, cols, prep, bal, spec = cfg.resolve_config(config_keys)
+    if tree_overrides and spec.name in tree_overrides:
+        spec = type(spec)(spec.name, tree_overrides[spec.name], spec.bootstrap,
+                          spec.random_splits, spec.sqrt_features)
+
+    x = np.asarray(feats[:, list(cols)], dtype=np.float32)
+    y = np.asarray(labels_raw) == fl
+    n = x.shape[0]
+
+    key = jax.random.PRNGKey(seed)
+    mu, wmat = jax.jit(fit_preprocess)(x, prep)
+    xp = transform(x, mu, wmat)
+
+    kb, kf = jax.random.split(key)
+    xs, ys, ws = resample(xp, y, np.ones(n, np.float32), bal, kb, 2 * n)
+    forest = trees.fit_forest(
+        xs, ys, ws, kf, n_trees=spec.n_trees, bootstrap=spec.bootstrap,
+        random_splits=spec.random_splits, sqrt_features=spec.sqrt_features,
+        max_depth=max_depth, max_nodes=4 * n,
+    )
+    return np.asarray(
+        treeshap.forest_shap_class0(forest, xp, sample_chunk=sample_chunk)
+    )
+
+
+def write_shap(tests_file=TESTS_FILE, out_file=SHAP_FILE, *, max_depth=48,
+               tree_overrides=None, sample_chunk=512):
+    """The two paper configs (reference write_shap experiment.py:520-530)."""
+    feats, labels, _, _, _ = _load_arrays(tests_file)
+    values = [
+        shap_for_config(keys, feats, labels, max_depth=max_depth,
+                        tree_overrides=tree_overrides,
+                        sample_chunk=sample_chunk)
+        for keys in cfg.SHAP_CONFIGS
+    ]
+    with open(out_file, "wb") as fd:
+        pickle.dump(values, fd)
+    return values

@@ -1,0 +1,180 @@
+"""Execution orchestration: Docker fan-out, in-container entrypoint, resume.
+
+Behavioral port of layers L1/L2 (SURVEY.md §1, §3.1; reference
+/root/reference/experiment.py:110-239). The contracts preserved exactly:
+
+- container naming ``{proj}_{mode}_{run_n}`` and per-mode plugin flags
+  (showflakes: ``--record-file=<f>.tsv`` [+ ``--shuffle``]; testinspect:
+  ``--testinspect=<f>``) — SURVEY.md §2 rows 8-9 are the plugin spec,
+- interfering-plugin blacklist and ``--set-exitstatus``,
+- 7200 s per-container timeout, ``--cpus=1`` isolation,
+- append-only ``log.txt`` resume ledger and exit status 1 on any failure,
+- per-container stdout capture to ``stdout/<name>``.
+
+Subprocess execution is injectable (``exec_fn``) so the whole layer is
+testable without Docker (this environment has none).
+"""
+
+import functools
+import os
+import shlex
+import subprocess as sp
+import sys
+
+from flake16_framework_tpu.constants import (
+    CONT_DATA_DIR, CONT_TIMEOUT, DATA_DIR, IMAGE_NAME, LOG_FILE,
+    N_RUNS, PIP_INSTALL, PIP_VERSION, PLUGIN_BLACKLIST, PLUGINS, STDOUT_DIR,
+    SUBJECTS_DIR,
+)
+from flake16_framework_tpu.runner.pool import run_pool
+from flake16_framework_tpu.runner.subjects import iter_subjects
+
+MODE_FLAGS = {
+    "testinspect": lambda f: [f"--testinspect={f}"],
+    "baseline": lambda f: [f"--record-file={f}.tsv"],
+    "shuffle": lambda f: [f"--record-file={f}.tsv", "--shuffle"],
+}
+
+
+def subject_paths(proj):
+    base = os.path.join(SUBJECTS_DIR, proj)
+    return {
+        "checkout": os.path.join(base, proj),
+        "venv_bin": os.path.join(base, "venv", "bin"),
+        "requirements": os.path.join(base, "requirements.txt"),
+        "venv": os.path.join(base, "venv"),
+    }
+
+
+def _venv_env(proj):
+    env = os.environ.copy()
+    env["PATH"] = subject_paths(proj)["venv_bin"] + ":" + env["PATH"]
+    return env
+
+
+def provision_subject(subject, exec_fn=sp.run):
+    """Build one subject's pinned virtualenv (L1; reference setup_project
+    experiment.py:110-125): venv, clone @ sha, pinned pip, both plugins,
+    subject editable install."""
+    paths = subject_paths(subject.name)
+    env = _venv_env(subject.name)
+
+    exec_fn(["virtualenv", paths["venv"]], check=True)
+    exec_fn(["git", "clone", subject.url, paths["checkout"]], check=True)
+    exec_fn(["git", "reset", "--hard", subject.sha], cwd=paths["checkout"],
+            check=True)
+
+    package_dir = os.path.join(paths["checkout"], subject.package_dir)
+    exec_fn([*PIP_INSTALL, PIP_VERSION], env=env, check=True)
+    exec_fn([*PIP_INSTALL, "-r", paths["requirements"]], env=env, check=True)
+    exec_fn([*PIP_INSTALL, *PLUGINS, "-e", package_dir], env=env, check=True)
+
+
+def _provision_worker(subject, exec_fn=sp.run):
+    # module-level so multiprocessing.Pool can pickle it
+    provision_subject(subject, exec_fn=exec_fn)
+    return f"provisioned: {subject.name}", subject.name
+
+
+def provision_all(subjects_file=None, exec_fn=sp.run, pool_kwargs=None):
+    """Provision every subject in parallel (reference setup_image
+    experiment.py:128-136)."""
+    os.makedirs(CONT_DATA_DIR, exist_ok=True)
+    subjects = list(iter_subjects(subjects_file) if subjects_file
+                    else iter_subjects())
+
+    worker = functools.partial(_provision_worker, exec_fn=exec_fn)
+    for _ in run_pool(worker, subjects, **(pool_kwargs or {})):
+        pass
+
+
+def container_entrypoint(cont_name, *commands, exec_fn=sp.run):
+    """In-container verb (reference manage_container experiment.py:139-161):
+    run setup commands, then pytest with the blacklist + mode flags."""
+    proj, mode, _ = cont_name.split("_", 2)
+    paths = subject_paths(proj)
+    data_file = os.path.join(CONT_DATA_DIR, cont_name)
+    env = _venv_env(proj)
+
+    for cmd in commands[:-1]:
+        exec_fn(shlex.split(cmd), cwd=paths["checkout"], env=env, check=True)
+
+    pytest_cmd = [
+        *shlex.split(commands[-1]), *PLUGIN_BLACKLIST, "--set-exitstatus",
+        *MODE_FLAGS[mode](data_file),
+    ]
+    exec_fn(pytest_cmd, timeout=CONT_TIMEOUT, cwd=paths["checkout"],
+            check=True, env=env)
+
+
+def docker_command(cont_name, commands, host_data_dir=None):
+    host_data_dir = host_data_dir or os.path.join(os.getcwd(), DATA_DIR)
+    return [
+        "docker", "run", "-it", f"-v={host_data_dir}:{CONT_DATA_DIR}:rw",
+        "--rm", "--init", "--cpus=1", f"--name={cont_name}", IMAGE_NAME,
+        "python3", "-m", "flake16_framework_tpu", "container", cont_name,
+        *commands,
+    ]
+
+
+def launch_container(args, exec_fn=sp.run):
+    """Host-side worker (reference run_container experiment.py:164-181):
+    docker run with stdout captured; returns pool-protocol tuple."""
+    cont_name, commands = args
+    stdout_file = os.path.join(STDOUT_DIR, cont_name)
+
+    with open(stdout_file, "a") as fd:
+        proc = exec_fn(docker_command(cont_name, commands), stdout=fd)
+
+    succeeded = proc.returncode == 0
+    message = "succeeded" if succeeded else "failed"
+    return f"{message}: {cont_name}", (succeeded, cont_name)
+
+
+def enumerate_containers(run_modes, subjects=None):
+    """All (name, commands) pairs: {proj} x {mode} x {run_n}
+    (reference iter_containers experiment.py:184-188)."""
+    for subject in (subjects if subjects is not None else iter_subjects()):
+        for mode in set(run_modes):
+            for run_n in range(N_RUNS[mode]):
+                yield f"{subject.name}_{mode}_{run_n}", subject.commands
+
+
+def read_ledger(path=LOG_FILE):
+    if not os.path.exists(path):
+        return set()
+    with open(path, "r") as fd:
+        return {line.strip() for line in fd if line.strip()}
+
+
+def append_ledger(cont_name, path=LOG_FILE):
+    with open(path, "a") as fd:
+        fd.write(f"{cont_name}\n")
+
+
+def run_experiment(run_modes, subjects=None, exec_fn=sp.run, pool_kwargs=None,
+                   exit_fn=sys.exit):
+    """Full collection campaign with resume (reference run_experiment
+    experiment.py:214-239): skip completed containers, append successes to the
+    ledger, exit nonzero if anything failed."""
+    os.makedirs(DATA_DIR, exist_ok=True)
+    os.makedirs(STDOUT_DIR, exist_ok=True)
+
+    done = read_ledger()
+    work = [
+        (name, commands)
+        for name, commands in enumerate_containers(run_modes, subjects)
+        if name not in done
+    ]
+
+    # partial over the module-level worker: picklable for multiprocessing.Pool
+    worker = functools.partial(launch_container, exec_fn=exec_fn)
+
+    exitstatus = 0
+    for succeeded, cont_name in run_pool(worker, work, **(pool_kwargs or {})):
+        if succeeded:
+            append_ledger(cont_name)
+        else:
+            exitstatus = 1
+
+    exit_fn(exitstatus)

@@ -1,0 +1,39 @@
+"""Subject registry.
+
+Behavioral port of the reference's registry (component 2, SURVEY.md §2;
+/root/reference/experiment.py:103-107 + subjects.txt): one CSV line per subject
+``owner/repo,sha,package_dir,cmd1[,cmd2...]`` where the trailing commands are
+the in-container setup steps plus the final pytest invocation.
+"""
+
+from dataclasses import dataclass
+
+from flake16_framework_tpu.constants import SUBJECTS_FILE
+
+
+@dataclass(frozen=True)
+class Subject:
+    name: str          # repo name without owner (container/venv key)
+    repo: str          # owner/name (GitHub path)
+    sha: str           # pinned commit
+    package_dir: str   # subdir pip-installed editable
+    commands: tuple    # setup commands + final pytest command
+
+    @property
+    def url(self):
+        return f"https://github.com/{self.repo}"
+
+
+def parse_subject_line(line):
+    repo, sha, package_dir, *commands = line.strip().split(",")
+    return Subject(
+        name=repo.split("/", 1)[1], repo=repo, sha=sha,
+        package_dir=package_dir, commands=tuple(commands),
+    )
+
+
+def iter_subjects(path=SUBJECTS_FILE):
+    with open(path, "r") as fd:
+        for line in fd:
+            if line.strip():
+                yield parse_subject_line(line)

@@ -1,0 +1,47 @@
+"""Brute-force numpy oracles for the resampler semantics (imbalanced-learn
+0.9.0 defaults, re-derived; imblearn itself is unavailable in this image).
+Deliberately slow and literal — these are test fixtures, not product code."""
+
+import numpy as np
+
+
+def _dists(x):
+    d = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    np.fill_diagonal(d, np.inf)
+    return d
+
+
+def _minority(y):
+    return 1 if (y == 1).sum() < (y == 0).sum() else 0
+
+
+def tomek_keep_ref(x, y, strategy_all):
+    d = _dists(x)
+    nn1 = d.argmin(1)
+    n = len(y)
+    link = np.zeros(n, bool)
+    for i in range(n):
+        j = nn1[i]
+        if y[i] != y[j] and nn1[j] == i:
+            link[i] = True
+    if not strategy_all:
+        link &= y != _minority(y)
+    return ~link
+
+
+def enn_keep_ref(x, y, strategy_all, k=3):
+    d = _dists(x)
+    n = len(y)
+    keep = np.ones(n, bool)
+    for i in range(n):
+        if not strategy_all and y[i] == _minority(y):
+            continue
+        nbrs = np.argsort(d[i], kind="stable")[:k]
+        if not all(y[j] == y[i] for j in nbrs):
+            keep[i] = False
+    return keep
+
+
+def smote_counts_ref(y):
+    m = _minority(y)
+    return int((y != m).sum() - (y == m).sum())

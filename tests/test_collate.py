@@ -1,0 +1,174 @@
+"""L3 collation/labeling truth tables — same strategy as the reference's unit
+tests (SURVEY.md §4): fake the plugin *outputs*, not the plugins."""
+
+import pickle
+import sqlite3
+
+import pytest
+
+from flake16_framework_tpu.constants import FLAKY, NON_FLAKY, OD_FLAKY
+from flake16_framework_tpu.runner import collate as C
+
+N = {"baseline": 4, "shuffle": 4, "testinspect": 1}
+
+
+def test_numbits_roundtrip():
+    # bit k of byte n => line 8n+k
+    assert C.numbits_to_lines(bytes([0b00000101])) == {0, 2}
+    assert C.numbits_to_lines(bytes([0, 0b10000000])) == {15}
+    assert C.numbits_to_lines(b"") == set()
+    blob = bytes([255, 255])
+    assert C.numbits_to_lines(blob) == set(range(16))
+
+
+def test_ingest_runs_tracks_min_runs():
+    proj = C.ProjectData()
+    C.ingest_runs_tsv(["passed\tt1", "failed\tt2"], "baseline", 3, proj)
+    C.ingest_runs_tsv(["failed\tt1", "failed\tt2"], "baseline", 1, proj)
+    C.ingest_runs_tsv(["passed\tt1", "passed\tt2"], "shuffle", 0, proj)
+
+    t1 = proj.tests["t1"].runs["baseline"]
+    assert (t1.n_runs, t1.n_fail, t1.min_fail_run, t1.min_pass_run) == (2, 1, 1, 3)
+    t2 = proj.tests["t2"].runs["baseline"]
+    assert (t2.n_runs, t2.n_fail, t2.min_fail_run, t2.min_pass_run) == (2, 2, 1, None)
+    assert proj.tests["t1"].runs["shuffle"].n_fail == 0
+
+
+def _stats(n_runs, n_fail, min_fail, min_pass):
+    s = C.RunStats()
+    s.n_runs, s.n_fail = n_runs, n_fail
+    s.min_fail_run, s.min_pass_run = min_fail, min_pass
+    return s
+
+
+@pytest.mark.parametrize("base,shuf,expected", [
+    # incomplete -> excluded
+    ((3, 0, None, 0), (4, 0, None, 0), (0, None)),
+    # never fails anywhere -> non-flaky
+    ((4, 0, None, 0), (4, 0, None, 0), (0, NON_FLAKY)),
+    # baseline clean, shuffle fails -> OD, req = first failing shuffle run
+    ((4, 0, None, 0), (4, 1, 2, 0), (2, OD_FLAKY)),
+    # always fails everywhere -> non-flaky (consistently broken)
+    ((4, 4, 0, None), (4, 4, 0, None), (0, NON_FLAKY)),
+    # always fails baseline, passes some shuffles -> OD, req = first passing
+    ((4, 4, 0, None), (4, 3, 0, 3), (3, OD_FLAKY)),
+    # intermittent baseline -> NOD, req = max(first fail, first pass)
+    ((4, 1, 2, 0), (4, 0, None, 0), (2, FLAKY)),
+    ((4, 3, 0, 1), (4, 4, 0, None), (1, FLAKY)),
+])
+def test_labeling_state_machine(base, shuf, expected):
+    runs = {"baseline": _stats(*base), "shuffle": _stats(*shuf)}
+    assert C.label_test(runs, N) == expected
+
+
+@pytest.mark.parametrize("cov,test_files,churn,expected", [
+    ({"a.py": {1, 2, 3}, "b.py": {1, 2, 3}}, {"a.py"},
+     {"a.py": {1: 1}, "b.py": {1: 1, 2: 2}}, (6, 4, 3)),
+    ({"a.py": {1, 2, 3}, "b.py": {1, 2, 3}}, set(),
+     {"a.py": {1: 1}, "b.py": {1: 1, 2: 2}}, (6, 4, 6)),
+    ({"a.py": {1}}, set(), {}, (1, 0, 1)),
+])
+def test_coverage_features(cov, test_files, churn, expected):
+    assert C.coverage_features(cov, test_files, churn) == expected
+
+
+def test_coverage_db_ingest(tmp_path):
+    db = tmp_path / "p_testinspect_0.sqlite3"
+    con = sqlite3.connect(db)
+    con.executescript("""
+        CREATE TABLE context (id INTEGER PRIMARY KEY, context TEXT);
+        CREATE TABLE file (id INTEGER PRIMARY KEY, path TEXT);
+        CREATE TABLE line_bits (context_id INT, file_id INT, numbits BLOB);
+    """)
+    con.execute("INSERT INTO context VALUES (1, 't1'), (2, 't2')")
+    root = C.os.path.join(C.SUBJECTS_DIR, "p", "p")
+    con.execute("INSERT INTO file VALUES (1, ?), (2, ?)",
+                (C.os.path.join(root, "src.py"),
+                 C.os.path.join(root, "tests", "test_src.py")))
+    con.execute("INSERT INTO line_bits VALUES (1, 1, ?)", (bytes([0b110]),))
+    con.execute("INSERT INTO line_bits VALUES (2, 2, ?)", (bytes([0b1000]),))
+    con.commit()
+
+    proj = C.ProjectData()
+    C.ingest_coverage_db(con, "p", proj)
+    assert proj.tests["t1"].coverage == {"src.py": {1, 2}}
+    assert proj.tests["t2"].coverage == {
+        C.os.path.join("tests", "test_src.py"): {3}
+    }
+
+
+def test_end_to_end_assembly(tmp_path):
+    # Build a full fake data/ dir for one project with 2 complete tests.
+    data = tmp_path / "data"
+    data.mkdir()
+    for mode in ("baseline", "shuffle"):
+        for run_n in range(N[mode]):
+            fail = mode == "shuffle" and run_n == 1
+            (data / f"proj_{mode}_{run_n}.tsv").write_text(
+                f"{'failed' if fail else 'passed'}\tt1\npassed\tt2\n"
+            )
+
+    db = data / "proj_testinspect_0.sqlite3"
+    con = sqlite3.connect(db)
+    con.executescript("""
+        CREATE TABLE context (id INTEGER PRIMARY KEY, context TEXT);
+        CREATE TABLE file (id INTEGER PRIMARY KEY, path TEXT);
+        CREATE TABLE line_bits (context_id INT, file_id INT, numbits BLOB);
+    """)
+    root = C.os.path.join(str(tmp_path), "proj", "proj")
+    con.execute("INSERT INTO context VALUES (1, 't1'), (2, 't2')")
+    con.execute("INSERT INTO file VALUES (1, ?)",
+                (C.os.path.join(root, "m.py"),))
+    con.execute("INSERT INTO line_bits VALUES (1, 1, ?)", (bytes([0b11]),))
+    con.execute("INSERT INTO line_bits VALUES (2, 1, ?)", (bytes([0b01]),))
+    con.commit()
+    con.close()
+
+    (data / "proj_testinspect_0.tsv").write_text(
+        "1.0\t2\t3\t4\t5\t6\tt1\n0.5\t1\t1\t1\t1\t1\tt2\n"
+    )
+    with open(data / "proj_testinspect_0.pkl", "wb") as fd:
+        pickle.dump((
+            # fn_id 0 is dropped by the reference's falsy completeness
+            # filter; use 1-based ids for the kept tests.
+            {"t1": 1, "t2": 2},                       # test_fn_ids
+            {1: (3, 1, 0, 9.9, 2, 12, 80.0),          # fn_id -> 7 static
+             2: (2, 0, 1, 5.5, 1, 8, 90.0)},
+            {"tests/test_m.py"},                       # test_files (non-empty)
+            {"m.py": {0: 2}},                          # churn
+        ), fd)
+
+    projects = C.collate(str(data), subjects_dir=str(tmp_path))
+    tests = C.assemble_tests(projects, N)
+
+    assert list(tests) == ["proj"]
+    assert list(tests["proj"]) == ["t1", "t2"]
+    t1 = tests["proj"]["t1"]
+    assert t1[0] == 1 and t1[1] == OD_FLAKY      # first failing shuffle run
+    assert t1[2:5] == (2, 2, 2)                  # lines, changes, src lines
+    assert t1[5:11] == (1.0, 2, 3, 4, 5, 6)
+    assert t1[11:] == (3, 1, 0, 9.9, 2, 12, 80.0)
+    t2 = tests["proj"]["t2"]
+    assert t2[1] == NON_FLAKY
+
+
+def test_falsy_completeness_matches_reference():
+    # Reference `all(...)` semantics (experiment.py:381,389): fn_id == 0 or an
+    # empty test_files/churn silently exclude the test/project.
+    rec = C.TestRecord()
+    rec.runs["baseline"] = _stats(4, 0, None, 0)
+    rec.coverage["a.py"] = {1}
+    rec.rusage = [1.0] * 6
+    rec.fn_id = 0
+    assert not rec.complete()
+    rec.fn_id = 1
+    assert rec.complete()
+
+    proj = C.ProjectData()
+    proj.tests["t"] = rec
+    proj.fn_features = {1: (1,) * 7}
+    proj.test_files = set()
+    proj.churn = {"a.py": {1: 1}}
+    assert not proj.complete()
+    proj.test_files = {"tests/x.py"}
+    assert proj.complete()

@@ -1,0 +1,98 @@
+"""End-to-end verbs: scores -> shap -> figures on a synthetic dataset, through
+the CLI dispatch (the minimum end-to-end slice of SURVEY.md §7 + outer layers)."""
+
+import json
+import pickle
+
+import numpy as np
+import pytest
+
+from flake16_framework_tpu import config as cfg
+from flake16_framework_tpu.__main__ import main
+from flake16_framework_tpu.figures.report import write_figures
+from flake16_framework_tpu.pipeline import write_scores, write_shap
+from flake16_framework_tpu.runner.subjects import Subject
+from flake16_framework_tpu.utils.synth import make_tests_json
+
+
+@pytest.fixture(scope="module")
+def workdir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("pipeline")
+    make_tests_json(str(d / "tests.json"), n_tests=150, n_projects=4, seed=21)
+    return d
+
+
+def test_cli_requires_command():
+    with pytest.raises(ValueError, match="No command"):
+        main([])
+    with pytest.raises(ValueError, match="Unrecognized"):
+        main(["frobnicate"])
+
+
+def test_scores_shap_figures_end_to_end(workdir, monkeypatch):
+    monkeypatch.chdir(workdir)
+    tiny = {"Extra Trees": 5, "Random Forest": 5}
+
+    # A representative config slice: every model family, both flaky types,
+    # every preprocessing, several balancers — incl. the figures' hard-coded
+    # comparison configs.
+    configs = [
+        ("NOD", "Flake16", "None", "None", "Decision Tree"),
+        ("NOD", "Flake16", "PCA", "SMOTE", "Extra Trees"),
+        ("NOD", "FlakeFlagger", "None", "Tomek Links", "Extra Trees"),
+        ("OD", "FlakeFlagger", "None", "SMOTE Tomek", "Extra Trees"),
+        ("OD", "Flake16", "Scaling", "SMOTE", "Random Forest"),
+        ("OD", "Flake16", "Scaling", "ENN", "Random Forest"),
+    ]
+    scores = write_scores(
+        configs=configs, max_depth=16, tree_overrides=tiny,
+        checkpoint_every=2,  # exercise the mid-sweep checkpoint dump
+        progress_out=open("progress.log", "w"),
+    )
+    assert set(scores) == set(configs)
+
+    with open("scores.pkl", "rb") as fd:
+        on_disk = pickle.load(fd)
+    assert set(on_disk) == set(configs)
+
+    # Resume: a second call runs nothing new (ledger hit).
+    scores2 = write_scores(
+        configs=configs, max_depth=16, tree_overrides=tiny,
+        progress_out=open("progress.log", "a"),
+    )
+    assert set(scores2) == set(configs)
+
+    shap_vals = write_shap(max_depth=12, tree_overrides=tiny, sample_chunk=64)
+    assert len(shap_vals) == 2
+    assert shap_vals[0].shape == (150, 16)
+    assert np.isfinite(shap_vals[0]).all()
+
+    # figures needs every config pair only for the comparison tables; fill
+    # top-10 tables by padding the scores dict with copies.
+    all_keys = list(cfg.iter_config_keys())
+    # pad with a config that has a scored F1 when one exists, so the top-10
+    # tables have rows
+    base = next(
+        (v for v in scores.values() if v[3][-1] is not None),
+        scores[configs[0]],
+    )
+    padded = {k: scores.get(k, base) for k in all_keys}
+    with open("scores.pkl", "wb") as fd:
+        pickle.dump(padded, fd)
+
+    tests = json.load(open("tests.json"))
+    subjects = [
+        Subject(name=p, repo=f"org/{p}", sha="x", package_dir=".",
+                commands=("pytest",))
+        for p in tests
+    ]
+    write_figures(subjects=subjects, star_fetch=lambda repo: {})
+
+    for name in ("tests.tex", "req-runs.tex", "corr.tex", "nod-top.tex",
+                 "od-top.tex", "nod-comp.tex", "od-comp.tex", "shap.tex"):
+        assert (workdir / name).exists(), name
+    for name in ("tests.tex", "req-runs.tex", "corr.tex", "shap.tex"):
+        assert (workdir / name).read_text().strip(), name
+
+    assert "\\addlegendentry{NOD}" in (workdir / "req-runs.tex").read_text()
+    assert (workdir / "tests.tex").read_text().count("org/") == 4

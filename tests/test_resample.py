@@ -1,0 +1,117 @@
+"""Resampler kernels vs numpy oracles (reference axis experiment.py:87-94)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from flake16_framework_tpu.config import (
+    BAL_NONE, BAL_TOMEK, BAL_SMOTE, BAL_ENN, BAL_SMOTE_ENN, BAL_SMOTE_TOMEK
+)
+from flake16_framework_tpu.ops.resample import resample, tomek_keep, enn_keep
+from ref_resamplers import tomek_keep_ref, enn_keep_ref, smote_counts_ref
+
+
+def _data(n=120, seed=0, frac=0.25):
+    rng = np.random.RandomState(seed)
+    y = rng.rand(n) < frac
+    x = rng.randn(n, 4) + 1.5 * y[:, None]
+    return x, y
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("strategy_all", [False, True])
+def test_tomek_matches_oracle(seed, strategy_all):
+    x, y = _data(seed=seed)
+    keep = np.asarray(
+        tomek_keep(jnp.asarray(x), jnp.asarray(y), jnp.ones(len(y)),
+                   strategy_all=strategy_all)
+    ) > 0
+    np.testing.assert_array_equal(keep, tomek_keep_ref(x, y, strategy_all))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("strategy_all", [False, True])
+def test_enn_matches_oracle(seed, strategy_all):
+    x, y = _data(seed=seed)
+    keep = np.asarray(
+        enn_keep(jnp.asarray(x), jnp.asarray(y), jnp.ones(len(y)),
+                 strategy_all=strategy_all)
+    ) > 0
+    np.testing.assert_array_equal(keep, enn_keep_ref(x, y, strategy_all))
+
+
+def test_masked_rows_are_inert():
+    # Rows with w=0 (fold-test rows) must not influence links/neighbourhoods.
+    x, y = _data(seed=3)
+    w = np.ones(len(y))
+    w[::3] = 0.0
+    keep_mask = np.asarray(
+        tomek_keep(jnp.asarray(x), jnp.asarray(y), jnp.asarray(w),
+                   strategy_all=False)
+    ) > 0
+    sub = w > 0
+    keep_ref = tomek_keep_ref(x[sub], y[sub], False)
+    np.testing.assert_array_equal(keep_mask[sub], keep_ref)
+    assert not keep_mask[~sub].any()
+
+
+def test_smote_balances_and_interpolates():
+    x, y = _data(n=100, seed=4, frac=0.2)
+    cap = 200
+    xs, ys, ws = (np.asarray(a) for a in resample(
+        jnp.asarray(x), jnp.asarray(y), jnp.ones(100), jnp.int32(BAL_SMOTE),
+        jax.random.PRNGKey(0), cap
+    ))
+    assert xs.shape == (cap, 4)
+    n_synth = int(ws[100:].sum())
+    assert n_synth == smote_counts_ref(y)
+    # Balanced after resampling.
+    n_pos = int(ws[ys == 1].sum())
+    n_neg = int(ws[ys == 0].sum())
+    assert n_pos == n_neg
+
+    # Every valid synthetic row lies on a segment between two minority rows.
+    x_min = x[y == 1]
+    for i in np.flatnonzero(ws[100:] > 0)[:20]:
+        p = xs[100 + i]
+        assert ys[100 + i] == 1
+        # distance from p to the nearest minority-pair segment ~ 0
+        best = np.inf
+        for a in range(len(x_min)):
+            ab = x_min - x_min[a]
+            ap = p - x_min[a]
+            denom = (ab * ab).sum(1)
+            t = np.where(denom > 0, (ab * ap).sum(1) / np.maximum(denom, 1e-12), 0)
+            t = np.clip(t, 0, 1)
+            proj = x_min[a] + t[:, None] * ab
+            best = min(best, ((proj - p) ** 2).sum(1).min())
+        assert best < 1e-10
+
+
+@pytest.mark.parametrize("code", [BAL_NONE, BAL_TOMEK, BAL_SMOTE, BAL_ENN,
+                                  BAL_SMOTE_ENN, BAL_SMOTE_TOMEK])
+def test_dispatch_shapes(code):
+    x, y = _data(n=80, seed=5)
+    xs, ys, ws = resample(
+        jnp.asarray(x), jnp.asarray(y), jnp.ones(80), jnp.int32(code),
+        jax.random.PRNGKey(1), 160
+    )
+    assert xs.shape == (160, 4) and ys.shape == (160,) and ws.shape == (160,)
+    assert float(ws.sum()) > 0
+
+
+def test_combos_clean_after_smote():
+    x, y = _data(n=100, seed=6, frac=0.15)
+    for code in (BAL_SMOTE_ENN, BAL_SMOTE_TOMEK):
+        xs, ys, ws = (np.asarray(a) for a in resample(
+            jnp.asarray(x), jnp.asarray(y), jnp.ones(100), jnp.int32(code),
+            jax.random.PRNGKey(2), 200
+        ))
+        xsm, ysm, wsm = (np.asarray(a) for a in resample(
+            jnp.asarray(x), jnp.asarray(y), jnp.ones(100), jnp.int32(BAL_SMOTE),
+            jax.random.PRNGKey(2), 200
+        ))
+        # Cleaning only removes samples from the SMOTE result.
+        assert set(np.flatnonzero(ws > 0)) <= set(np.flatnonzero(wsm > 0))
+        assert ws.sum() <= wsm.sum()

@@ -1,0 +1,138 @@
+"""Orchestration layer tests with injected executors (no Docker here) — the
+coverage the reference never had (SURVEY.md §4 "Not tested: orchestration")."""
+
+import io
+import os
+
+from flake16_framework_tpu.constants import CONT_TIMEOUT, PLUGIN_BLACKLIST
+from flake16_framework_tpu.runner import containers as R
+from flake16_framework_tpu.runner.pool import SerialPool, run_pool
+from flake16_framework_tpu.runner.subjects import parse_subject_line
+
+
+class FakeProc:
+    def __init__(self, returncode=0):
+        self.returncode = returncode
+
+
+class Recorder:
+    def __init__(self, fail_names=()):
+        self.calls = []
+        self.fail_names = fail_names
+
+    def __call__(self, cmd, **kw):
+        self.calls.append((cmd, kw))
+        rc = 1 if any(n in " ".join(cmd) for n in self.fail_names) else 0
+        return FakeProc(rc)
+
+
+def test_parse_subject_line():
+    s = parse_subject_line("owner/proj,abc123,src,python setup.py x,pytest -q")
+    assert s.name == "proj" and s.sha == "abc123"
+    assert s.commands == ("python setup.py x", "pytest -q")
+    assert s.url == "https://github.com/owner/proj"
+
+
+def test_container_entrypoint_flags():
+    rec = Recorder()
+    R.container_entrypoint(
+        "proj_shuffle_7", "python prep.py", "pytest -x", exec_fn=rec
+    )
+    # setup command first, in the checkout, with venv on PATH
+    cmd0, kw0 = rec.calls[0]
+    assert cmd0 == ["python", "prep.py"]
+    assert kw0["cwd"].endswith(os.path.join("proj", "proj"))
+    assert kw0["env"]["PATH"].startswith(
+        os.path.join(R.SUBJECTS_DIR, "proj", "venv", "bin")
+    )
+    # pytest run: blacklist + exitstatus + shuffle-mode showflakes flags
+    cmd1, kw1 = rec.calls[1]
+    assert cmd1[:2] == ["pytest", "-x"]
+    for flag in PLUGIN_BLACKLIST:
+        assert flag in cmd1
+    assert "--set-exitstatus" in cmd1
+    assert any(a.startswith("--record-file=") and a.endswith("proj_shuffle_7.tsv")
+               for a in cmd1)
+    assert "--shuffle" in cmd1
+    assert kw1["timeout"] == CONT_TIMEOUT
+
+
+def test_container_entrypoint_testinspect_flag():
+    rec = Recorder()
+    R.container_entrypoint("proj_testinspect_0", "pytest", exec_fn=rec)
+    cmd, _ = rec.calls[-1]
+    assert any(a.startswith("--testinspect=") for a in cmd)
+    assert not any(a.startswith("--record-file") for a in cmd)
+
+
+def test_enumerate_containers():
+    s = parse_subject_line("o/p,sha,dir,pytest")
+    names = [n for n, _ in R.enumerate_containers(
+        ["baseline"], subjects=[s]
+    )]
+    assert len(names) == 2500
+    assert names[0] == "p_baseline_0"
+
+
+def test_run_experiment_resume_and_ledger(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    s = parse_subject_line("o/p,sha,dir,pytest")
+    # pretend one run already completed
+    with open("log.txt", "w") as fd:
+        fd.write("p_baseline_0\n")
+
+    import flake16_framework_tpu.constants as const
+    monkeypatch.setitem(const.N_RUNS, "baseline", 3)
+
+    rec = Recorder(fail_names=["p_baseline_2"])
+    codes = []
+    R.run_experiment(
+        ["baseline"], subjects=[s], exec_fn=rec,
+        pool_kwargs={"pool_factory": SerialPool, "out": io.StringIO()},
+        exit_fn=codes.append,
+    )
+    assert codes == [1]  # one container failed
+    launched = [c for c, _ in rec.calls]
+    assert all(cmd[0] == "docker" for cmd in launched)
+    names = {a.split("=")[1] for cmd in launched for a in cmd
+             if a.startswith("--name=")}
+    assert names == {"p_baseline_1", "p_baseline_2"}  # _0 resumed from ledger
+    # ledger gained only the success
+    assert R.read_ledger() == {"p_baseline_0", "p_baseline_1"}
+    # stdout captured per container
+    assert set(os.listdir("stdout")) == {"p_baseline_1", "p_baseline_2"}
+
+
+def test_run_pool_progress_protocol():
+    out = io.StringIO()
+    results = list(run_pool(
+        lambda a: (f"done {a}", a * 2), [1, 2, 3],
+        pool_factory=SerialPool, out=out, seed=0,
+    ))
+    assert sorted(results) == [2, 4, 6]
+    assert "done" in out.getvalue()
+
+
+def test_pool_workers_are_picklable():
+    # multiprocessing.Pool pickles the worker per task; the production path
+    # must not use closures (regression guard for the Pool crash).
+    import functools
+    import pickle
+    import subprocess as sp
+
+    w1 = functools.partial(R.launch_container, exec_fn=sp.run)
+    w2 = functools.partial(R._provision_worker, exec_fn=sp.run)
+    assert pickle.loads(pickle.dumps(w1)).func is R.launch_container
+    assert pickle.loads(pickle.dumps(w2)).func is R._provision_worker
+
+
+def test_provision_subject_commands():
+    rec = Recorder()
+    s = parse_subject_line("o/p,abc,src,pytest")
+    R.provision_subject(s, exec_fn=rec)
+    joined = [" ".join(c) for c, _ in rec.calls]
+    assert any(j.startswith("virtualenv") for j in joined)
+    assert any("git clone https://github.com/o/p" in j for j in joined)
+    assert any("git reset --hard abc" in j for j in joined)
+    assert any("pip install -I --no-deps pip==21.2.1" in j for j in joined)
+    assert any("-e" in c for c, _ in rec.calls)

@@ -1,0 +1,111 @@
+"""Sweep engine: single-config CV parity vs a hand-built sklearn pipeline,
+grid schema, ledger resume, and the sharded multi-device path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.model_selection import StratifiedKFold
+from sklearn.tree import DecisionTreeClassifier
+
+from flake16_framework_tpu import config as cfg
+from flake16_framework_tpu.constants import FLAKY
+from flake16_framework_tpu.parallel import sweep
+from flake16_framework_tpu.utils.synth import make_dataset
+
+
+@pytest.fixture(scope="module")
+def engine():
+    feats, labels, pids = make_dataset(n_tests=240, n_projects=6, seed=11)
+    names = [f"project{p:02d}" for p in range(6)]
+    projects = np.array([names[p] for p in pids])
+    return sweep.SweepEngine(
+        feats, labels, projects, names, pids,
+        max_depth=24, tree_overrides={"Extra Trees": 8, "Random Forest": 8},
+    )
+
+
+def test_dt_config_total_confusion_matches_sklearn(engine):
+    # The BASELINE.json probe config: NOD/Flake16/None/None/Decision Tree.
+    # No preprocessing, no balancing, single deterministic-path tree: total
+    # confusion counts must be close to sklearn's (tie noise only).
+    res = engine.run_config(("NOD", "Flake16", "None", "None", "Decision Tree"))
+    t_train, t_test, scores, total = res
+    assert t_train > 0 and t_test > 0
+
+    x = engine.features.astype(np.float64)
+    y = engine.labels_raw == FLAKY
+    skf = StratifiedKFold(n_splits=10, shuffle=True, random_state=0)
+    fp = fn = tp = 0
+    for tr, te in skf.split(x, y):
+        m = DecisionTreeClassifier(random_state=0).fit(x[tr], y[tr])
+        p = m.predict(x[te])
+        fp += int((~y[te] & p).sum())
+        fn += int((y[te] & ~p).sum())
+        tp += int((y[te] & p).sum())
+
+    ours = np.array(total[:3])
+    theirs = np.array([fp, fn, tp])
+    # Identical fold assignment (exact KFold replication); residual diffs are
+    # tree tie-break noise on a handful of samples.
+    assert np.abs(ours - theirs).sum() <= max(4, int(0.25 * theirs.sum()))
+
+
+def test_grid_subset_schema_and_ledger(engine):
+    configs = [
+        ("NOD", "Flake16", "None", "None", "Decision Tree"),
+        ("OD", "FlakeFlagger", "Scaling", "SMOTE", "Extra Trees"),
+        ("NOD", "Flake16", "PCA", "Tomek Links", "Random Forest"),
+    ]
+    done = {}
+    scores = engine.run_grid(configs, ledger=done)
+    assert set(scores) == set(configs)
+    for keys, (t_train, t_test, per_proj, total) in scores.items():
+        assert len(total) == 6
+        assert set(per_proj) == set(engine.project_names)
+        for row in per_proj.values():
+            assert len(row) == 6
+            assert all(isinstance(v, int) for v in row[:3])
+
+    # Ledger resume: nothing re-runs (results are passed through by identity).
+    again = engine.run_grid(configs, ledger=scores)
+    assert all(again[k] is scores[k] for k in scores)
+
+
+def test_sharded_family_matches_single_device(engine):
+    # 8 virtual CPU devices; DT family is RNG-free, so the sharded batch must
+    # reproduce the per-config path exactly.
+    mesh = sweep.default_mesh()
+    n_dev = len(jax.devices())
+    spec = engine._spec("Decision Tree")
+    n, nf = engine.features.shape
+
+    fn = sweep.make_sharded_family_fn(
+        spec, mesh, n=n, n_feat=nf, n_projects=len(engine.project_names),
+        max_depth=24,
+    )
+
+    prep_names = ["None", "Scaling", "PCA", "None", "Scaling", "PCA", "None",
+                  "Scaling"][:n_dev]
+    bal_names = ["None", "None", "None", "Tomek Links", "Tomek Links",
+                 "Tomek Links", "ENN", "ENN"][:n_dev]
+    trm, tem = engine._masks["NOD"]
+
+    counts = fn(
+        jnp.asarray(engine.features),
+        jnp.asarray(engine.labels_raw),
+        jnp.full((n_dev,), FLAKY, jnp.int32),
+        jnp.asarray([cfg.PREPROCESSINGS[p] for p in prep_names], jnp.int32),
+        jnp.asarray([cfg.BALANCINGS[b] for b in bal_names], jnp.int32),
+        jax.random.split(jax.random.PRNGKey(0), n_dev),
+        jnp.broadcast_to(trm, (n_dev, *trm.shape)),
+        jnp.broadcast_to(tem, (n_dev, *tem.shape)),
+        jnp.asarray(engine.project_ids),
+    )
+    counts = np.asarray(counts)
+    assert counts.shape == (n_dev, len(engine.project_names), 3)
+
+    for i, (p, b) in enumerate(zip(prep_names, bal_names)):
+        res = engine.run_config(("NOD", "Flake16", p, b, "Decision Tree"))
+        total = res[3][:3]
+        np.testing.assert_array_equal(counts[i].sum(0), total)

@@ -1,0 +1,136 @@
+"""Tree SHAP vs a brute-force Shapley oracle + local-accuracy invariant.
+
+The oracle enumerates all feature subsets and computes the path-dependent
+conditional expectation exactly (the definition TreeExplainer implements in
+C); feasible for tiny trees only, which is precisely the reference's
+fake-the-output test strategy (SURVEY.md §4)."""
+
+import itertools
+import math
+
+import numpy as np
+import jax
+import pytest
+
+from flake16_framework_tpu.ops.trees import Forest, fit_forest
+from flake16_framework_tpu.ops.treeshap import (
+    expected_p0, extract_paths, forest_shap_class0, tree_shap_single
+)
+
+
+def path_dependent_expectation(tree, node, x, subset):
+    """E[f(x) | features in `subset` fixed] under cover weighting."""
+    feat, thr, left, right, value = tree
+    f = feat[node]
+    if f < 0:
+        v = value[node]
+        return v[0] / v.sum()
+    if f in subset:
+        nxt = left[node] if x[f] <= thr[node] else right[node]
+        return path_dependent_expectation(tree, nxt, x, subset)
+    cl = value[left[node]].sum()
+    cr = value[right[node]].sum()
+    el = path_dependent_expectation(tree, left[node], x, subset)
+    er = path_dependent_expectation(tree, right[node], x, subset)
+    return (cl * el + cr * er) / (cl + cr)
+
+
+def brute_force_shap(tree, x, n_features):
+    """Exact Shapley values over the full feature set."""
+    phi = np.zeros(n_features)
+    all_f = list(range(n_features))
+    for i in all_f:
+        rest = [f for f in all_f if f != i]
+        for r in range(len(rest) + 1):
+            for s in itertools.combinations(rest, r):
+                wgt = (math.factorial(len(s))
+                       * math.factorial(n_features - len(s) - 1)
+                       / math.factorial(n_features))
+                gain = (
+                    path_dependent_expectation(tree, 0, x, set(s) | {i})
+                    - path_dependent_expectation(tree, 0, x, set(s))
+                )
+                phi[i] += wgt * gain
+    return phi
+
+
+def _np_tree(forest, t=0):
+    return tuple(
+        np.asarray(a[t]) for a in (forest.feature, forest.threshold,
+                                   forest.left, forest.right, forest.value)
+    )
+
+
+@pytest.mark.parametrize("seed,n,f", [(0, 40, 4), (1, 60, 5), (2, 30, 3)])
+def test_single_tree_matches_brute_force(seed, n, f):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f)
+    y = (x[:, 0] + 0.5 * x[:, -1] + 0.3 * rng.randn(n)) > 0
+
+    forest = fit_forest(
+        x, y, np.ones(n), jax.random.PRNGKey(seed), n_trees=1, bootstrap=False,
+        random_splits=False, sqrt_features=False, max_depth=6, max_nodes=64,
+    )
+
+    xq = rng.randn(5, f)
+    phi = np.asarray(forest_shap_class0(forest, xq))
+
+    tree = _np_tree(forest)
+    for q in range(5):
+        expected = brute_force_shap(tree, xq[q], f)
+        np.testing.assert_allclose(phi[q], expected, atol=1e-8)
+
+
+def test_local_accuracy_forest():
+    # sum_f phi_f(x) == p0(x) - E[p0] for the ensemble, every sample.
+    rng = np.random.RandomState(3)
+    n, f = 120, 6
+    x = rng.randn(n, f)
+    y = (x[:, 1] - x[:, 2] + 0.5 * rng.randn(n)) > 0
+
+    forest = fit_forest(
+        x, y, np.ones(n), jax.random.PRNGKey(0), n_trees=7, bootstrap=True,
+        random_splits=False, sqrt_features=True, max_depth=10, max_nodes=256,
+    )
+
+    from flake16_framework_tpu.ops.trees import predict_proba
+
+    xq = rng.randn(30, f)
+    phi = np.asarray(forest_shap_class0(forest, xq))
+    p0 = np.asarray(predict_proba(forest, xq))[:, 0]
+    base = float(expected_p0(forest))
+    np.testing.assert_allclose(phi.sum(1), p0 - base, atol=1e-6)
+
+
+def test_sample_chunking_matches():
+    rng = np.random.RandomState(4)
+    x = rng.randn(50, 4)
+    y = x[:, 0] > 0
+    forest = fit_forest(
+        x, y, np.ones(50), jax.random.PRNGKey(1), n_trees=3, bootstrap=False,
+        random_splits=True, sqrt_features=True, max_depth=8, max_nodes=128,
+    )
+    xq = rng.randn(23, 4)
+    a = np.asarray(forest_shap_class0(forest, xq))
+    b = np.asarray(forest_shap_class0(forest, xq, sample_chunk=8))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-9)
+
+
+def test_extract_paths_ratios():
+    # Hand-built stump: root splits f0 at 0; covers 3/7 left, 4/7 right.
+    import jax.numpy as jnp
+
+    feature = jnp.array([0, -1, -1], jnp.int32)
+    threshold = jnp.array([0.0, 0.0, 0.0])
+    left = jnp.array([1, -1, -1], jnp.int32)
+    right = jnp.array([2, -1, -1], jnp.int32)
+    value = jnp.array([[3.0, 4.0], [3.0, 0.0], [0.0, 4.0]])
+
+    paths = extract_paths(feature, threshold, left, right, value, 4)
+    ok = np.asarray(paths["leaf_ok"])
+    assert ok.sum() == 2
+    ratios = np.asarray(paths["sratio"])[ok]
+    valid = np.asarray(paths["svalid"])[ok]
+    assert valid.sum() == 2  # one step each
+    got = sorted(r[v][0] for r, v in zip(ratios, valid))
+    np.testing.assert_allclose(got, [3 / 7, 4 / 7])
