@@ -41,7 +41,18 @@ def main(argv=None):
     elif command == "scores":
         from flake16_framework_tpu.pipeline import write_scores
 
-        write_scores()
+        # Optional extension verbs the reference CLI lacks: `scores lopo`
+        # runs the 26-project leave-one-project-out CV (north star) to
+        # scores-lopo.pkl; `scores profile=DIR` captures a jax.profiler trace.
+        kw = {}
+        for a in args:
+            if a == "lopo":
+                kw["cv"] = "lopo"  # default out_file follows the cv scheme
+            elif a.startswith("profile="):
+                kw["profile_dir"] = a.split("=", 1)[1]
+            else:
+                raise ValueError(f"Unrecognized scores option {a!r}")
+        write_scores(**kw)
     elif command == "shap":
         from flake16_framework_tpu.pipeline import write_shap
 

@@ -12,6 +12,10 @@ LOG_FILE = "log.txt"
 SHAP_FILE = "shap.pkl"
 TESTS_FILE = "tests.json"
 SCORES_FILE = "scores.pkl"
+# The 26-project leave-one-project-out sweep (north-star extension; not a
+# reference artifact) writes here so it can never clobber or resume from the
+# reference-schema stratified scores.pkl.
+LOPO_SCORES_FILE = "scores-lopo.pkl"
 SUBJECTS_FILE = "subjects.txt"
 REQUIREMENTS_FILE = "requirements.txt"
 

@@ -309,17 +309,24 @@ def _bootstrap_weights(w, key):
     jax.jit,
     static_argnames=(
         "n_trees", "bootstrap", "random_splits", "sqrt_features", "max_depth",
-        "max_nodes",
+        "max_nodes", "tree_chunk",
     ),
 )
 def fit_forest(x, y, w, key, *, n_trees, bootstrap, random_splits,
-               sqrt_features, max_depth=48, max_nodes=None):
+               sqrt_features, max_depth=48, max_nodes=None, tree_chunk=None):
     """Fit an ensemble. x [N,F]; y [N] (bool/int); w [N] >= 0 sample weights
     (0 = row excluded). Returns Forest with [T, ...] leading axis.
 
     DecisionTree = n_trees=1, bootstrap=False, random_splits=False,
     sqrt_features=False. RandomForest = 100/True/False/True.
     ExtraTrees = 100/False/True/True. (reference experiment.py:96-98)
+
+    ``tree_chunk`` bounds how many trees grow concurrently: trees ride an
+    inner vmap of that width under a sequential ``lax.map`` over chunks.
+    The per-level split-search workspace is O(trees_in_flight x F x
+    max_nodes); an unchunked 100-tree x 10-fold ensemble fit overruns TPU
+    device memory, so sweep-level callers pass a chunk (results are
+    identical — per-tree PRNG keys don't depend on the chunking).
     """
     n, f = x.shape
     if max_nodes is None:
@@ -345,7 +352,17 @@ def fit_forest(x, y, w, key, *, n_trees, bootstrap, random_splits,
             max_features=max_features, max_depth=max_depth, max_nodes=max_nodes,
         )
 
-    feature, threshold, left, right, value, n_nodes = jax.vmap(one)(keys)
+    if tree_chunk is None or tree_chunk >= n_trees:
+        feature, threshold, left, right, value, n_nodes = jax.vmap(one)(keys)
+    else:
+        pad = (-n_trees) % tree_chunk
+        keys_p = jnp.concatenate([keys, keys[:pad]]).reshape(
+            -1, tree_chunk, 2
+        )
+        out = lax.map(jax.vmap(one), keys_p)
+        feature, threshold, left, right, value, n_nodes = jax.tree.map(
+            lambda a: a.reshape(-1, *a.shape[2:])[:n_trees], out
+        )
     return Forest(feature, threshold, left, right, value, n_nodes,
                   jnp.int32(max_depth))
 

@@ -53,3 +53,15 @@ def fold_masks(labels, n_splits=N_SPLITS, seed=0):
     test_folds = stratified_fold_ids(labels, n_splits, seed)
     test = (test_folds[None, :] == np.arange(n_splits)[:, None])
     return (~test).astype(np.float32), test.astype(np.float32)
+
+
+def lopo_fold_masks(project_ids, n_projects):
+    """Leave-one-project-out CV masks: fold p trains on every project but p
+    and tests on p (the 26-project LOPO CV of the north star — BASELINE.json;
+    the reference has only the 10-fold stratified split, this is the
+    cross-project generalization variant the flaky-test literature pairs with
+    it). Same (train [P, N], test [P, N]) mask contract as ``fold_masks`` so
+    the fold axis rides the identical vmap/shard path."""
+    pids = np.asarray(project_ids)
+    test = (pids[None, :] == np.arange(n_projects)[:, None])
+    return (~test).astype(np.float32), test.astype(np.float32)

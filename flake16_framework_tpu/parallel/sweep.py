@@ -33,64 +33,138 @@ from flake16_framework_tpu.ops.metrics import confusion_by_project, format_score
 from flake16_framework_tpu.ops.preprocess import fit_preprocess, transform
 from flake16_framework_tpu.ops.resample import resample
 from flake16_framework_tpu.ops import trees
-from flake16_framework_tpu.parallel.folds import fold_masks
+from flake16_framework_tpu.parallel.folds import fold_masks, lopo_fold_masks
 
 N_FOLDS = 10
 
 
-def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
-                n_folds=N_FOLDS):
-    """Build (cv_fit, cv_score) jitted for one model family.
+def _auto_tree_chunk(spec, n_folds, tree_chunk, budget=64):
+    """Bound concurrent tree fits at ~``budget`` across the fold x tree grid
+    (fit_forest docstring: unchunked 100x10 overruns TPU memory)."""
+    if tree_chunk is not None:
+        return tree_chunk
+    if spec.n_trees * n_folds <= budget:
+        return None
+    return max(1, budget // n_folds)
 
-    cv_fit(x, y_raw, flaky_label, prep_code, bal_code, key, train_mask)
+
+def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
+                     n_folds=N_FOLDS, tree_chunk=None):
+    """The per-config CV pipeline, unjitted: (fit_one, score_one).
+
+    fit_one(x, y_raw, flaky_label, prep_code, bal_code, key, train_mask)
         -> (forest stacked over folds, xp, y)
-    cv_score(forest, xp, y, test_mask, project_ids) -> counts [P, 3]
+    score_one(forest, xp, y, test_mask, project_ids) -> counts [P, 3]
 
-    All config axes inside a family are traced ints; shapes depend only on
-    (n, n_feat, spec) so each family compiles exactly once.
+    Single source of truth for preprocess -> resample -> fit -> predict ->
+    confusion; the jitted single-config and shard_mapped batched entry points
+    below are thin wrappers, so changes (e.g. tree_chunk plumbing) land once.
     """
     if cap is None:
         cap = 2 * n  # SMOTE at worst doubles the training set
     max_nodes = 2 * cap
+    tree_chunk = _auto_tree_chunk(spec, n_folds, tree_chunk)
 
-    def _fit_one_fold(xp, y, bal_code, fold_key, w_train):
-        kb, kf = jax.random.split(fold_key)
-        xs, ys, ws = resample(xp, y, w_train, bal_code, kb, cap)
-        return trees.fit_forest(
-            xs, ys, ws, kf, n_trees=spec.n_trees, bootstrap=spec.bootstrap,
-            random_splits=spec.random_splits, sqrt_features=spec.sqrt_features,
-            max_depth=max_depth, max_nodes=max_nodes,
-        )
-
-    @jax.jit
-    def cv_fit(x, y_raw, flaky_label, prep_code, bal_code, key, train_mask):
+    def fit_one(x, y_raw, flaky_label, prep_code, bal_code, key, train_mask):
         y = y_raw == flaky_label
         mu, wmat = fit_preprocess(x, prep_code)
         xp = transform(x, mu, wmat)
         fold_keys = jax.random.split(key, n_folds)
-        forest = jax.vmap(
-            lambda k, w: _fit_one_fold(xp, y, bal_code, k, w)
-        )(fold_keys, train_mask)
+
+        def fold(fold_key, w_train):
+            kb, kf = jax.random.split(fold_key)
+            xs, ys, ws = resample(xp, y, w_train, bal_code, kb, cap)
+            return trees.fit_forest(
+                xs, ys, ws, kf, n_trees=spec.n_trees,
+                bootstrap=spec.bootstrap, random_splits=spec.random_splits,
+                sqrt_features=spec.sqrt_features, max_depth=max_depth,
+                max_nodes=max_nodes, tree_chunk=tree_chunk,
+            )
+
+        forest = jax.vmap(fold)(fold_keys, train_mask)
         return forest, xp, y
 
-    @jax.jit
-    def cv_score(forest, xp, y, test_mask, project_ids):
+    def score_one(forest, xp, y, test_mask, project_ids):
         preds = jax.vmap(lambda f: trees.predict(f, xp))(forest)
         return confusion_by_project(
             y, preds, test_mask, project_ids, n_projects
         )
 
-    return cv_fit, cv_score
+    return fit_one, score_one
 
 
-def _family_configs(fs_name, model_name):
-    """The 36 config key-tuples of one (feature-set, model) family, in
-    reference sweep order."""
-    out = []
-    for keys in cfg.iter_config_keys():
-        if keys[1] == fs_name and keys[4] == model_name:
-            out.append(keys)
-    return out
+def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
+                n_folds=N_FOLDS, tree_chunk=None):
+    """Build (cv_fit, cv_score) jitted for one model family.
+
+    All config axes inside a family are traced ints; shapes depend only on
+    (n, n_feat, spec) so each family compiles exactly once.
+    """
+    fit_one, score_one = _make_config_fns(
+        spec, n=n, n_projects=n_projects, cap=cap, max_depth=max_depth,
+        n_folds=n_folds, tree_chunk=tree_chunk,
+    )
+    return jax.jit(fit_one), jax.jit(score_one)
+
+
+def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
+                        n_folds=N_FOLDS, tree_chunk=None):
+    """Two-stage config-batched CV over the mesh's "config" axis — the
+    production sweep path (the reference forks a process per config,
+    experiment.py:493-498; here a batch of configs is one SPMD program).
+
+    Returns (fit_b, score_b):
+      fit_b(x, y_raw, fls [B], preps [B], bals [B], keys [B,2],
+            train_masks [B,folds,N]) -> (forest [B,folds,...], xp [B,N,F'],
+            y [B,N]) — all sharded over "config", left on device.
+      score_b(forest, xp, y, test_masks [B,folds,N], project_ids)
+            -> counts [B,P,3].
+    Two stages (not one fused call) so the reference's per-config
+    T_TRAIN/T_TEST split (experiment.py:468-474) stays measurable, like
+    ``make_cv_fns``. B must be a multiple of the mesh "config" axis size;
+    within a shard, configs ride a vmap axis.
+    """
+    fit_one, score_one = _make_config_fns(
+        spec, n=n, n_projects=n_projects, max_depth=max_depth,
+        n_folds=n_folds, tree_chunk=tree_chunk,
+    )
+
+    def fit_batch(x, y_raw, fls, preps, bals, keys, train_masks):
+        return jax.vmap(
+            lambda fl, prep, bal, key, trm: fit_one(
+                x, y_raw, fl, prep, bal, key, trm
+            )
+        )(fls, preps, bals, keys, train_masks)
+
+    def score_batch(forest, xp, y, test_masks, project_ids):
+        return jax.vmap(
+            lambda f, xpi, yi, tem: score_one(f, xpi, yi, tem, project_ids)
+        )(forest, xp, y, test_masks)
+
+    pspec = P("config")
+    forest_specs = jax.tree.map(lambda _: pspec, trees.Forest(
+        *[0] * len(trees.Forest._fields)
+    ))
+    fit_b = jax.jit(
+        jax.shard_map(
+            fit_batch, mesh=mesh,
+            in_specs=(P(), P(), pspec, pspec, pspec, pspec, pspec),
+            out_specs=(forest_specs, pspec, pspec),
+            # Replicated data arrays mix with config-varying codes inside
+            # lax.switch; jax 0.9's varying-manual-axes validator rejects
+            # that conservatively (its own error message says to disable).
+            check_vma=False,
+        )
+    )
+    score_b = jax.jit(
+        jax.shard_map(
+            score_batch, mesh=mesh,
+            in_specs=(forest_specs, pspec, pspec, pspec, P()),
+            out_specs=pspec,
+            check_vma=False,
+        )
+    )
+    return fit_b, score_b
 
 
 class SweepEngine:
@@ -104,7 +178,7 @@ class SweepEngine:
 
     def __init__(self, features, labels_raw, projects, project_names,
                  project_ids, *, mesh=None, max_depth=48, seed=0,
-                 n_folds=N_FOLDS, tree_overrides=None):
+                 n_folds=None, tree_overrides=None, cv="stratified"):
         self.features = np.asarray(features, dtype=np.float32)
         self.labels_raw = np.asarray(labels_raw, dtype=np.int32)
         self.projects = projects
@@ -113,18 +187,34 @@ class SweepEngine:
         self.mesh = mesh
         self.max_depth = max_depth
         self.seed = seed
-        self.n_folds = n_folds
+        self.cv = cv
         # tests shrink ensembles: {"Random Forest": 10, ...}
         self.tree_overrides = tree_overrides or {}
         self._fns = {}
+        self._sharded_fns = {}
         # Fold masks depend on the label vector => per flaky type
         # (reference re-splits per config, experiment.py:449-450; identical
-        # within a flaky type).
+        # within a flaky type). LOPO folds (north-star 26-project CV) depend
+        # only on project ids, so both flaky types share them.
         self._masks = {}
-        for fl_name, fl in cfg.FLAKY_TYPES.items():
-            self._masks[fl_name] = fold_masks(
-                self.labels_raw == fl, n_splits=n_folds, seed=0
-            )
+        if cv == "stratified":
+            self.n_folds = N_FOLDS if n_folds is None else n_folds
+            for fl_name, fl in cfg.FLAKY_TYPES.items():
+                self._masks[fl_name] = fold_masks(
+                    self.labels_raw == fl, n_splits=self.n_folds, seed=0
+                )
+        elif cv == "lopo":
+            if n_folds is not None:
+                raise ValueError(
+                    "cv='lopo' derives its fold count from the project set; "
+                    "an explicit n_folds would be silently wrong"
+                )
+            self.n_folds = len(project_names)
+            lopo = lopo_fold_masks(self.project_ids, self.n_folds)
+            for fl_name in cfg.FLAKY_TYPES:
+                self._masks[fl_name] = lopo
+        else:
+            raise ValueError(f"unknown cv scheme {cv!r}")
 
     def _spec(self, model_name):
         spec = cfg.MODELS[model_name]
@@ -188,81 +278,110 @@ class SweepEngine:
         return [t_train / self.n_folds, t_test / self.n_folds, scores,
                 scores_total]
 
+    def _get_sharded_fns(self, fs_name, model_name):
+        key = (fs_name, model_name)
+        if key not in self._sharded_fns:
+            n, _ = self.features.shape
+            cols = list(cfg.FEATURE_SETS[fs_name])
+            self._sharded_fns[key] = (
+                make_sharded_cv_fns(
+                    self._spec(model_name), self.mesh, n=n, n_feat=len(cols),
+                    n_projects=len(self.project_names),
+                    max_depth=self.max_depth, n_folds=self.n_folds,
+                ),
+                cols,
+            )
+        return self._sharded_fns[key]
+
+    def run_config_batch(self, config_batch):
+        """Run a batch of same-family configs over the mesh's config axis.
+        Returns a list of per-config results in the run_config schema; batch
+        wall-clock is attributed evenly (per-config times on a shared SPMD
+        step are not separable — documented deviation from the reference's
+        per-process clocks)."""
+        fs_name, model_name = config_batch[0][1], config_batch[0][4]
+        assert all(k[1] == fs_name and k[4] == model_name
+                   for k in config_batch)
+        (fit_b, score_b), cols = self._get_sharded_fns(fs_name, model_name)
+
+        d = self.mesh.devices.size
+        pad = (-len(config_batch)) % d
+        batch = list(config_batch) + [config_batch[0]] * pad
+        b = len(batch)
+
+        all_keys = list(cfg.iter_config_keys())
+        fls = np.array([cfg.FLAKY_TYPES[k[0]] for k in batch], np.int32)
+        preps = np.array([cfg.PREPROCESSINGS[k[2]] for k in batch], np.int32)
+        bals = np.array([cfg.BALANCINGS[k[3]] for k in batch], np.int32)
+        keys = np.stack([
+            np.asarray(jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                                          all_keys.index(tuple(k))))
+            for k in batch
+        ])
+        trms = np.stack([self._masks[k[0]][0] for k in batch])
+        tems = np.stack([self._masks[k[0]][1] for k in batch])
+
+        x = jnp.asarray(self.features[:, cols])
+        t0 = time.time()
+        forest, xp, y = fit_b(
+            x, jnp.asarray(self.labels_raw), jnp.asarray(fls),
+            jnp.asarray(preps), jnp.asarray(bals), jnp.asarray(keys),
+            jnp.asarray(trms),
+        )
+        jax.block_until_ready(forest)
+        t_train = (time.time() - t0) / b
+
+        t0 = time.time()
+        counts = score_b(forest, xp, y, jnp.asarray(tems),
+                         jnp.asarray(self.project_ids))
+        counts = np.asarray(counts)
+        t_test = (time.time() - t0) / b
+
+        out = []
+        for i in range(len(config_batch)):
+            scores, scores_total = format_scores(
+                counts[i], self.project_names, self.projects
+            )
+            out.append([t_train / self.n_folds, t_test / self.n_folds,
+                        scores, scores_total])
+        return out
+
     def run_grid(self, config_list=None, ledger=None, progress=None):
         """Run many configs; returns {config_keys: [t_train, t_test, scores,
         scores_total]}. ``ledger`` is a dict of already-done configs to skip
         (per-config resume, unlike the reference). ``progress`` receives
         (i, total, keys, live_scores) after each config — live_scores is the
-        accumulating dict, so callers can checkpoint it mid-sweep."""
+        accumulating dict, so callers can checkpoint it mid-sweep.
+
+        With a mesh attached, same-family configs are batched across the
+        "config" mesh axis (the ICI analog of the reference's process pool);
+        without one, configs run one jitted step at a time."""
         scores = dict(ledger or {})
         if config_list is None:
             config_list = cfg.iter_config_keys()
-        todo = [k for k in config_list if tuple(k) not in scores]
-        for i, keys in enumerate(todo):
-            scores[tuple(keys)] = self.run_config(keys)
-            if progress is not None:
-                progress(i + 1, len(todo), keys, scores)
+        todo = [tuple(k) for k in config_list if tuple(k) not in scores]
+
+        if self.mesh is None or self.mesh.devices.size <= 1:
+            for i, keys in enumerate(todo):
+                scores[keys] = self.run_config(keys)
+                if progress is not None:
+                    progress(i + 1, len(todo), keys, scores)
+            return scores
+
+        families = {}
+        for keys in todo:
+            families.setdefault((keys[1], keys[4]), []).append(keys)
+        d = self.mesh.devices.size
+        done = 0
+        for fam_configs in families.values():
+            for lo in range(0, len(fam_configs), d):
+                batch = fam_configs[lo:lo + d]
+                for keys, res in zip(batch, self.run_config_batch(batch)):
+                    scores[keys] = res
+                    done += 1
+                    if progress is not None:
+                        progress(done, len(todo), keys, scores)
         return scores
-
-
-def make_sharded_family_fn(spec, mesh, *, n, n_feat, n_projects,
-                           max_depth=48, n_folds=N_FOLDS):
-    """Config-batched CV over a mesh axis "config" — one device per config
-    shard, the ICI analog of the reference's process pool.
-
-    Returns fn(x, y_raw, flaky_labels [B], prep_codes [B], bal_codes [B],
-    keys [B,2], train_masks [B,folds,N], test_masks [B,folds,N],
-    project_ids) -> counts [B, P, 3], with B a multiple of the mesh's
-    "config" axis size. The data arrays are replicated; only the config axis
-    is split, so the only cross-device traffic is the parameter scatter and
-    the tiny counts gather.
-    """
-    cap = 2 * n
-    max_nodes = 2 * cap
-
-    def one_config(x, y_raw, fl, prep, bal, key, train_mask, test_mask,
-                   project_ids):
-        y = y_raw == fl
-        mu, wmat = fit_preprocess(x, prep)
-        xp = transform(x, mu, wmat)
-        fold_keys = jax.random.split(key, n_folds)
-
-        def fold(k, w_train):
-            kb, kf = jax.random.split(k)
-            xs, ys, ws = resample(xp, y, w_train, bal, kb, cap)
-            forest = trees.fit_forest(
-                xs, ys, ws, kf, n_trees=spec.n_trees,
-                bootstrap=spec.bootstrap, random_splits=spec.random_splits,
-                sqrt_features=spec.sqrt_features, max_depth=max_depth,
-                max_nodes=max_nodes,
-            )
-            return trees.predict(forest, xp)
-
-        preds = jax.vmap(fold)(fold_keys, train_mask)
-        return confusion_by_project(y, preds, test_mask, project_ids,
-                                    n_projects)
-
-    def batched(x, y_raw, fls, preps, bals, keys, train_masks, test_masks,
-                project_ids):
-        return jax.vmap(
-            lambda fl, prep, bal, key, trm, tem: one_config(
-                x, y_raw, fl, prep, bal, key, trm, tem, project_ids
-            )
-        )(fls, preps, bals, keys, train_masks, test_masks)
-
-    pspec = P("config")
-    return jax.jit(
-        jax.shard_map(
-            batched, mesh=mesh,
-            in_specs=(P(), P(), pspec, pspec, pspec, pspec, pspec, pspec,
-                      P()),
-            out_specs=pspec,
-            # Replicated data arrays mix with config-varying codes inside
-            # lax.switch; jax 0.9's varying-manual-axes validator rejects
-            # that conservatively (its own error message says to disable).
-            check_vma=False,
-        )
-    )
 
 
 def default_mesh(axis="config"):

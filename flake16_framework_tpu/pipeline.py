@@ -18,7 +18,9 @@ import jax
 import numpy as np
 
 from flake16_framework_tpu import config as cfg
-from flake16_framework_tpu.constants import SCORES_FILE, SHAP_FILE, TESTS_FILE
+from flake16_framework_tpu.constants import (
+    LOPO_SCORES_FILE, SCORES_FILE, SHAP_FILE, TESTS_FILE,
+)
 from flake16_framework_tpu.data import load_tests, tests_to_arrays
 from flake16_framework_tpu.ops import trees, treeshap
 from flake16_framework_tpu.ops.preprocess import fit_preprocess, transform
@@ -30,15 +32,31 @@ def _load_arrays(tests_file):
     return tests_to_arrays(load_tests(tests_file))
 
 
-def write_scores(tests_file=TESTS_FILE, out_file=SCORES_FILE, *,
+def write_scores(tests_file=TESTS_FILE, out_file=None, *,
                  max_depth=48, tree_overrides=None, configs=None,
-                 checkpoint_every=12, progress_out=sys.stdout):
+                 checkpoint_every=12, progress_out=sys.stdout,
+                 cv="stratified", mesh=None, profile_dir=None):
     """Run the (216-config x 10-fold) sweep and pickle the reference-schema
-    scores dict. Resumes from an existing partial ``out_file``."""
+    scores dict. Resumes from an existing partial ``out_file``.
+
+    ``cv="lopo"`` switches to the 26-project leave-one-project-out CV
+    (BASELINE.json north star); its default output is ``scores-lopo.pkl`` —
+    tied to the cv scheme so a LOPO run can never silently resume from (and
+    return) a stratified ledger. With more than one device, configs are
+    batched across a "config" mesh axis over ICI; pass ``mesh`` to override
+    the default all-local-devices mesh. ``profile_dir`` wraps the sweep in a
+    ``jax.profiler.trace`` (the tracing hook the reference lacks —
+    SURVEY.md §5)."""
+    if out_file is None:
+        out_file = SCORES_FILE if cv == "stratified" else LOPO_SCORES_FILE
     feats, labels, projects, names, pids = _load_arrays(tests_file)
+    if mesh is None and len(jax.devices()) > 1:
+        from flake16_framework_tpu.parallel.sweep import default_mesh
+
+        mesh = default_mesh()
     engine = SweepEngine(
         feats, labels, projects, names, pids, max_depth=max_depth,
-        tree_overrides=tree_overrides,
+        tree_overrides=tree_overrides, cv=cv, mesh=mesh,
     )
 
     ledger = {}
@@ -56,7 +74,13 @@ def write_scores(tests_file=TESTS_FILE, out_file=SCORES_FILE, *,
         if i % checkpoint_every == 0:
             _dump(live_scores, out_file)
 
-    scores_all = engine.run_grid(configs, ledger=ledger, progress=progress)
+    if profile_dir is not None:
+        with jax.profiler.trace(profile_dir):
+            scores_all = engine.run_grid(configs, ledger=ledger,
+                                         progress=progress)
+    else:
+        scores_all = engine.run_grid(configs, ledger=ledger,
+                                     progress=progress)
     _dump(scores_all, out_file)
     return scores_all
 
@@ -93,6 +117,10 @@ def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
         xs, ys, ws, kf, n_trees=spec.n_trees, bootstrap=spec.bootstrap,
         random_splits=spec.random_splits, sqrt_features=spec.sqrt_features,
         max_depth=max_depth, max_nodes=4 * n,
+        # Largest divisor of n_trees within the memory budget: no chunk
+        # padding (a chunk of 64 would fit-and-discard 28 extra trees).
+        tree_chunk=max(c for c in range(1, min(64, spec.n_trees) + 1)
+                       if spec.n_trees % c == 0),
     )
     return np.asarray(
         treeshap.forest_shap_class0(forest, xp, sample_chunk=sample_chunk)

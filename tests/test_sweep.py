@@ -72,15 +72,58 @@ def test_grid_subset_schema_and_ledger(engine):
     assert all(again[k] is scores[k] for k in scores)
 
 
-def test_sharded_family_matches_single_device(engine):
-    # 8 virtual CPU devices; DT family is RNG-free, so the sharded batch must
-    # reproduce the per-config path exactly.
+def test_sharded_engine_matches_per_config_path(engine):
+    # run_grid with a mesh (production sharded path, DT family = RNG-free)
+    # must reproduce the per-config path's counts exactly, including the
+    # padded final batch (5 configs on 8 devices).
+    feats, labels, pids = make_dataset(n_tests=240, n_projects=6, seed=11)
+    names = [f"project{p:02d}" for p in range(6)]
+    projects = np.array([names[p] for p in pids])
+    sh_engine = sweep.SweepEngine(
+        feats, labels, projects, names, pids, max_depth=24,
+        mesh=sweep.default_mesh(),
+    )
+    configs = [
+        ("NOD", "Flake16", p, b, "Decision Tree")
+        for p, b in [("None", "None"), ("Scaling", "None"), ("PCA", "None"),
+                     ("None", "Tomek Links"), ("Scaling", "ENN")]
+    ]
+    sharded = sh_engine.run_grid(configs)
+    for keys in configs:
+        res = engine.run_config(keys)
+        assert sharded[keys][3][:3] == res[3][:3]
+        assert {k: v[:3] for k, v in sharded[keys][2].items()} == {
+            k: v[:3] for k, v in res[2].items()
+        }
+
+
+def test_lopo_cv_runs_and_holds_out_projects(engine):
+    feats, labels, pids = make_dataset(n_tests=240, n_projects=6, seed=11)
+    names = [f"project{p:02d}" for p in range(6)]
+    projects = np.array([names[p] for p in pids])
+    lopo = sweep.SweepEngine(
+        feats, labels, projects, names, pids, max_depth=24, cv="lopo",
+    )
+    assert lopo.n_folds == 6
+    res = lopo.run_config(("NOD", "Flake16", "None", "None", "Decision Tree"))
+    _, _, per_proj, total = res
+    # every sample is in exactly one test fold => scored exactly once:
+    # totals bound by N, and per-project counts bound by project size.
+    assert sum(total[:3]) <= 240
+    sizes = {names[p]: int((pids == p).sum()) for p in range(6)}
+    for proj, row in per_proj.items():
+        assert sum(row[:3]) <= sizes[proj]
+
+
+def test_sharded_cv_fns_match_single_device(engine):
+    # 8 virtual CPU devices; DT family is RNG-free, so the sharded two-stage
+    # batch must reproduce the per-config path exactly.
     mesh = sweep.default_mesh()
     n_dev = len(jax.devices())
     spec = engine._spec("Decision Tree")
     n, nf = engine.features.shape
 
-    fn = sweep.make_sharded_family_fn(
+    fit_b, score_b = sweep.make_sharded_cv_fns(
         spec, mesh, n=n, n_feat=nf, n_projects=len(engine.project_names),
         max_depth=24,
     )
@@ -91,7 +134,7 @@ def test_sharded_family_matches_single_device(engine):
                  "Tomek Links", "ENN", "ENN"][:n_dev]
     trm, tem = engine._masks["NOD"]
 
-    counts = fn(
+    forest, xp, y = fit_b(
         jnp.asarray(engine.features),
         jnp.asarray(engine.labels_raw),
         jnp.full((n_dev,), FLAKY, jnp.int32),
@@ -99,10 +142,11 @@ def test_sharded_family_matches_single_device(engine):
         jnp.asarray([cfg.BALANCINGS[b] for b in bal_names], jnp.int32),
         jax.random.split(jax.random.PRNGKey(0), n_dev),
         jnp.broadcast_to(trm, (n_dev, *trm.shape)),
-        jnp.broadcast_to(tem, (n_dev, *tem.shape)),
-        jnp.asarray(engine.project_ids),
     )
-    counts = np.asarray(counts)
+    counts = np.asarray(score_b(
+        forest, xp, y, jnp.broadcast_to(tem, (n_dev, *tem.shape)),
+        jnp.asarray(engine.project_ids),
+    ))
     assert counts.shape == (n_dev, len(engine.project_names), 3)
 
     for i, (p, b) in enumerate(zip(prep_names, bal_names)):

@@ -36,6 +36,22 @@ def test_predict_uses_fit_depth():
     np.testing.assert_array_equal(np.asarray(predict(f, x)), y)
 
 
+def test_tree_chunk_is_bit_exact():
+    # The chunked lax.map path (the memory-critical production route for
+    # 100-tree ensembles) must produce exactly the same forest as the flat
+    # vmap, including with padding (7 trees, chunk 3) and bootstrap RNG.
+    rng = np.random.RandomState(0)
+    x = rng.randn(120, 5)
+    y = rng.rand(120) < 0.3
+    w = np.ones(120)
+    kw = dict(n_trees=7, bootstrap=True, random_splits=True,
+              sqrt_features=True, max_depth=10)
+    f_flat = fit_forest(x, y, w, jax.random.PRNGKey(3), **kw)
+    f_chunk = fit_forest(x, y, w, jax.random.PRNGKey(3), tree_chunk=3, **kw)
+    for a, b in zip(f_flat, f_chunk):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_bootstrap_never_selects_zero_weight_rows():
     w = np.ones(50)
     w[:25] = 0.0
