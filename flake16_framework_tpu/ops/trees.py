@@ -5,16 +5,21 @@ Cython tree stack (SURVEY.md §2 table B rows 1-3; reference call sites
 Design (TPU-first, not a port):
 
 - **Static shapes.** A tree is a fixed-capacity structure-of-arrays
-  (``Forest``): ``max_nodes`` slots regardless of data. Growth is level-by-level
-  for ``max_depth`` iterations of a ``fori_loop``; a node that cannot split
-  simply never changes, so finished trees are a fixed point and no dynamic
-  control flow is needed.
-- **Exact gini best-splits without per-node loops.** Per feature, sample order
-  by value is precomputed once; each level a single *stable* argsort by node id
-  yields (node, value)-lexicographic order, so weighted class prefix sums +
-  per-node base offsets give every candidate split's left/right counts in one
-  cumsum. This is the sort-based exact split of GPU gradient-boosting systems,
-  mapped to XLA ops (batched over the feature axis, vmapped over trees).
+  (``Forest``): ``max_nodes`` slots regardless of data. Growth is breadth-first
+  level-by-level under a ``lax.while_loop`` that stops as soon as no node can
+  split (or the depth bound is hit); BFS allocation makes every level's new
+  nodes a *contiguous* id range, so all node writes are
+  ``dynamic_update_slice`` windows.
+- **Scatter-free level step.** TPU lowers multi-thousand-segment
+  ``segment_sum``/``segment_max`` to scatters, which serialize and dominated
+  an earlier implementation. The level step here uses only sort, cumulative
+  scans, gathers, and ``searchsorted``:
+  one stable argsort per feature puts samples in (node, value) order; run
+  boundaries come from neighbor compares + ``cummax``/``cummin``; per-node and
+  per-candidate statistics are prefix-sum differences at run boundaries; the
+  best candidate per node is a segmented suffix-scan; dense per-node lookups
+  are ``searchsorted`` binary-search gathers into the sorted run starts
+  (runs are in node order, so sorted lookup replaces scatter entirely).
 - **Integer-exact scoring.** Weighted counts are small integers, exact in f32;
   the gini proxy is reformulated as ``d_L^2/w_L + d_R^2/w_R`` with
   ``d = w0 - w1`` (equal to sklearn's proxy up to a per-node constant), which
@@ -22,9 +27,10 @@ Design (TPU-first, not a port):
   without f64.
 - **Masking, not dynamic shapes.** Fold membership, resampler validity, and
   bootstrap multiplicities all arrive as one per-sample weight vector; rows
-  with zero weight are parked in a dummy segment and never influence splits,
-  thresholds, or leaf values — the moral equivalent of sklearn fitting on a
-  shorter array, under XLA's static-shape rules.
+  with zero weight (and rows whose node has finished) are parked in a dummy
+  frontier slot and never influence splits, thresholds, or leaf values — the
+  moral equivalent of sklearn fitting on a shorter array, under XLA's
+  static-shape rules.
 
 Replicated sklearn 1.0.2 semantics (defaults of the reference estimators):
 gini, ``splitter=best``/``random``, unbounded depth (bounded here by a generous
@@ -84,8 +90,8 @@ def _select_features(nc, key, max_features):
     """sklearn splitter feature sampling: draw features in uniform-random order,
     skip constants, stop after ``max_features`` non-constant ones.
 
-    nc: [M1, F] bool — feature non-constant within node.
-    Returns sel [M1, F] bool. With fewer than max_features non-constant
+    nc: [W, F] bool — feature non-constant within node.
+    Returns sel [W, F] bool. With fewer than max_features non-constant
     features, all of them are selected (sklearn exhausts the draw).
     """
     if max_features is None:
@@ -96,196 +102,284 @@ def _select_features(nc, key, max_features):
     return (r <= kth) & nc
 
 
-def _best_exact_splits(sample_node, w, wy, order0, xsorted, tot_w, tot_wy,
-                       max_nodes):
-    """Exact best-split search over all features for all current nodes.
+def _run_boundaries(s_rel):
+    """Per sorted position: start/end index of its (contiguous) node run.
 
-    Returns (score [F, M1], thr [F, M1], nonconstant [F, M1]) where M1 =
-    max_nodes + 1 (last segment parks zero-weight samples).
+    s_rel [..., N] is sorted; runs are maximal equal stretches. Pure
+    compares + cummax/cummin — no segment ops.
     """
-    m1 = max_nodes + 1
-    n = sample_node.shape[0]
-
-    node_of = sample_node[order0]  # [F, N]
-    perm = jnp.argsort(node_of, axis=1, stable=True)
-    sidx = jnp.take_along_axis(order0, perm, axis=1)
-    s_node = jnp.take_along_axis(node_of, perm, axis=1)
-    s_val = jnp.take_along_axis(xsorted, perm, axis=1)
-    s_w = w[sidx]
-    s_wy = wy[sidx]
-
-    cw = jnp.cumsum(s_w, axis=1)
-    cwy = jnp.cumsum(s_wy, axis=1)
-    start_w = _exclusive_cumsum(tot_w)
-    start_wy = _exclusive_cumsum(tot_wy)
-
-    lw = cw - start_w[s_node]
-    lwy = cwy - start_wy[s_node]
-    rw = tot_w[s_node] - lw
-    rwy = tot_wy[s_node] - lwy
-
-    nxt_node = jnp.concatenate([s_node[:, 1:], jnp.full_like(s_node[:, :1], -1)],
-                               axis=1)
-    nxt_val = jnp.concatenate([s_val[:, 1:], s_val[:, :1]], axis=1)
-    valid = (
-        (s_node == nxt_node)
-        & (s_node < max_nodes)
-        & (nxt_val - s_val > FEATURE_EPS)
-        & (lw > 0)
-        & (rw > 0)
+    n = s_rel.shape[-1]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones_like(s_rel[..., :1], bool),
+         s_rel[..., 1:] != s_rel[..., :-1]], axis=-1
     )
-
-    score = _proxy_score(lw, lwy, rw, rwy, valid)
-
-    seg = jax.vmap(
-        lambda s, ids: jax.ops.segment_max(s, ids, num_segments=m1,
-                                           indices_are_sorted=True)
+    is_end = jnp.concatenate(
+        [s_rel[..., 1:] != s_rel[..., :-1],
+         jnp.ones_like(s_rel[..., :1], bool)], axis=-1
     )
-    best = seg(score, s_node)  # [F, M1]
-
-    at_best = valid & (score == jnp.take_along_axis(best, s_node, axis=1))
-    pos = jnp.where(at_best, jnp.arange(n)[None, :], n)
-    segmin = jax.vmap(
-        lambda s, ids: jax.ops.segment_min(s, ids, num_segments=m1,
-                                           indices_are_sorted=True)
+    seg_start = lax.cummax(jnp.where(is_start, iota, -1), axis=s_rel.ndim - 1)
+    seg_end = lax.cummin(
+        jnp.where(is_end, iota, n), axis=s_rel.ndim - 1, reverse=True
     )
-    best_pos = jnp.clip(segmin(pos, s_node), 0, n - 2)  # [F, M1]
-
-    v_lo = jnp.take_along_axis(s_val, best_pos, axis=1)
-    v_hi = jnp.take_along_axis(s_val, best_pos + 1, axis=1)
-    thr = (v_lo + v_hi) / 2.0
-    thr = jnp.where(thr == v_hi, v_lo, thr)  # sklearn midpoint rounding guard
-
-    return best, thr, jnp.isfinite(best)
+    return seg_start, seg_end
 
 
-def _best_random_splits(sample_node, w, wy, x, tot_w, tot_wy, max_nodes, key):
-    """ExtraTrees random-threshold splits: per (node, feature) threshold uniform
-    in [node_min, node_max), best among candidate features by the same proxy.
-    No sorting — only segment min/max/sum — which is why ExtraTrees is the
-    TPU-friendliest of the three reference models (SURVEY.md §2 table B)."""
-    m1 = max_nodes + 1
-    pos_w = w > 0
-
-    xt = x.T  # [F, N]
-    seg_min = jax.vmap(
-        lambda v: jax.ops.segment_min(jnp.where(pos_w, v, jnp.inf), sample_node,
-                                      num_segments=m1)
+def _prefix_stats(vals, seg_start, seg_end):
+    """(within-run inclusive prefix sum, run total) for ``vals`` [..., N]."""
+    c = jnp.cumsum(vals, axis=-1)
+    before = jnp.where(
+        seg_start > 0,
+        jnp.take_along_axis(c, jnp.maximum(seg_start - 1, 0), axis=-1),
+        0.0,
     )
-    seg_max = jax.vmap(
-        lambda v: jax.ops.segment_max(jnp.where(pos_w, v, -jnp.inf), sample_node,
-                                      num_segments=m1)
+    prefix = c - before
+    total = jnp.take_along_axis(c, seg_end, axis=-1) - before
+    return prefix, total
+
+
+def _segmented_suffix_best(seg, score, n):
+    """For each position i: (max score, min position among maxima) over
+    [i .. end of i's run]. Associative segmented suffix scan — the
+    scatter-free replacement for per-node segment_max/segment_argmax."""
+    pos = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32), score.shape)
+
+    def comb(a, b):
+        # After the flip, ``a`` accumulates the ORIGINAL-order suffix of
+        # ``b``'s position; keep b's key, merging stats only within a run.
+        ka, sa, pa = a
+        kb, sb, pb = b
+        same = ka == kb
+        better = same & ((sa > sb) | ((sa == sb) & (pa < pb)))
+        return (kb, jnp.where(better, sa, sb), jnp.where(better, pa, pb))
+
+    flipped = jax.tree.map(
+        lambda t: jnp.flip(t, -1), (seg, score, pos)
     )
-    nmin = seg_min(xt)  # [F, M1]
-    nmax = seg_max(xt)
-    nc = nmax > nmin + FEATURE_EPS
+    _, s, p = lax.associative_scan(comb, flipped, axis=score.ndim - 1)
+    return jnp.flip(s, -1), jnp.flip(p, -1)
 
-    u = jax.random.uniform(key, nmin.shape, dtype=x.dtype)
-    thr = nmin + u * (nmax - nmin)
-    thr = jnp.where(thr >= nmax, nmin, thr)  # sklearn RandomSplitter guard
 
-    t_s = thr[:, :][:, sample_node]  # [F, N] threshold of each sample's node
-    left = xt <= t_s
+def _node_lookup(s_rel, w_cap):
+    """searchsorted lookup of each dense node slot's run start.
 
-    seg_sum = jax.vmap(
-        lambda v: jax.ops.segment_sum(v, sample_node, num_segments=m1)
-    )
-    lw = seg_sum(jnp.where(left, w[None, :], 0.0))
-    lwy = seg_sum(jnp.where(left, wy[None, :], 0.0))
-    rw = tot_w[None, :] - lw
-    rwy = tot_wy[None, :] - lwy
+    Returns (pos_j [..., W] int32, present [..., W] bool): runs appear in
+    node order inside the sorted array, so a binary-search gather replaces
+    the scatter that a dense per-node layout would otherwise need.
+    """
+    slots = jnp.arange(w_cap, dtype=s_rel.dtype)
+    pos_j = jax.vmap(
+        lambda a: jnp.searchsorted(a, slots, side="left")
+    )(s_rel).astype(jnp.int32)
+    n = s_rel.shape[-1]
+    safe = jnp.minimum(pos_j, n - 1)
+    present = jnp.take_along_axis(s_rel, safe, axis=-1) == slots
+    present = present & (pos_j < n)
+    return safe, present
 
-    valid = nc & (lw > 0) & (rw > 0)
-    score = _proxy_score(lw, lwy, rw, rwy, valid)
 
-    return score, thr, nc
+def _window_update(arr, start, updates, mask):
+    """Masked dynamic_update_slice: write ``updates`` [W] at [start, start+W),
+    preserving existing contents where ``mask`` is False. ``arr`` must be
+    padded so the window is always in bounds (no XLA start clamping)."""
+    w = updates.shape[0]
+    old = lax.dynamic_slice_in_dim(arr, start, w)
+    merged = jnp.where(mask, updates.astype(arr.dtype), old)
+    return lax.dynamic_update_slice_in_dim(arr, merged, start, axis=0)
 
 
 def _fit_one_tree(x, y01, w, key, order0, xsorted, *, random_splits,
                   max_features, max_depth, max_nodes):
-    """Grow one tree level-by-level. All shapes static; returns Forest fields."""
-    n, _ = x.shape
-    m = max_nodes
+    """Grow one tree level-by-level (see module docstring). Node arrays are
+    padded by 2*W so every window write is statically in bounds; the caller
+    slices back to max_nodes. Returns Forest field arrays."""
+    n, n_feat = x.shape
     dt = x.dtype
+    w_cap = n            # frontier rel-ids live in [0, n); n = parked
+    park = jnp.int32(w_cap)
+    m_pad = max_nodes + 2 * w_cap
 
-    feature = jnp.full((m,), -1, jnp.int32)
-    threshold = jnp.zeros((m,), dt)
-    left = jnp.full((m,), -1, jnp.int32)
-    right = jnp.full((m,), -1, jnp.int32)
-    value = jnp.zeros((m, 2), dt)
-    n_nodes = jnp.int32(1)
-    # Zero-weight rows live in the parked segment `m` and never resurface.
-    sample_node = jnp.where(w > 0, 0, m).astype(jnp.int32)
+    feature = jnp.full((m_pad,), -1, jnp.int32)
+    threshold = jnp.zeros((m_pad,), dt)
+    left = jnp.full((m_pad,), -1, jnp.int32)
+    right = jnp.full((m_pad,), -1, jnp.int32)
+    value = jnp.zeros((m_pad, 2), dt)
 
     wy = w * y01
+    live = w > 0
+    sample_rel = jnp.where(live, 0, w_cap).astype(jnp.int32)
+    # Root cover (the only node not created as a child of a split).
+    tot_w0, tot_wy0 = jnp.sum(w), jnp.sum(wy)
+    value = value.at[0].set(jnp.stack([tot_w0 - tot_wy0, tot_wy0]))
 
-    def level(d, state):
-        feature, threshold, left, right, value, n_nodes, sample_node = state
+    def level(state):
+        (feature, threshold, left, right, value, n_nodes, level_base,
+         sample_rel, d) = state
         kf, kt = jax.random.split(jax.random.fold_in(key, d))
 
-        tot_w = jax.ops.segment_sum(w, sample_node, num_segments=m + 1)
-        tot_wy = jax.ops.segment_sum(wy, sample_node, num_segments=m + 1)
+        # ---- sorted (node, value) order per feature -----------------------
+        key_f = sample_rel[order0]                      # [F, N]
+        perm = jnp.argsort(key_f, axis=-1, stable=True)
+        s_rel = jnp.take_along_axis(key_f, perm, axis=-1)
+        sidx = jnp.take_along_axis(order0, perm, axis=-1)
+        s_val = jnp.take_along_axis(xsorted, perm, axis=-1)
+        s_w = w[sidx]
+        s_wy = wy[sidx]
 
-        # Record cover/class counts the first time a node holds samples.
-        counts = jnp.stack([tot_w - tot_wy, tot_wy], axis=-1)[:m]
-        value = jnp.where(tot_w[:m, None] > 0, counts, value)
+        seg_start, seg_end = _run_boundaries(s_rel)
+        lw_pre, tot_w = _prefix_stats(s_w, seg_start, seg_end)
+        lwy_pre, tot_wy = _prefix_stats(s_wy, seg_start, seg_end)
+        pos_j, present = _node_lookup(s_rel, w_cap)     # [F, W]
 
-        impure = (tot_wy > 0) & (tot_w - tot_wy > 0)
+        active = s_rel < park
+        v_next = jnp.concatenate(
+            [s_val[:, 1:], s_val[:, -1:]], axis=-1
+        )
+        iota = jnp.arange(n, dtype=jnp.int32)
+
+        def gather_j(a):                                # [F, N] -> [F, W]
+            return jnp.take_along_axis(a, pos_j, axis=-1)
+
+        tot_w_j = gather_j(tot_w)
+        tot_wy_j = gather_j(tot_wy)
+        v_lo_j = gather_j(s_val)                        # run start = node min
+        v_hi_j = jnp.take_along_axis(s_val, gather_j(seg_end), axis=-1)
+        nc_j = present & (v_hi_j - v_lo_j > FEATURE_EPS)
 
         if random_splits:
-            score, thr, nc = _best_random_splits(
-                sample_node, w, wy, x, tot_w, tot_wy, m, kt
+            # ExtraTrees: one uniform threshold per (feature, node) in
+            # [node_min, node_max); left mass via prefix sums of the left
+            # indicator (values are sorted within a run, so the indicator is
+            # a prefix and its run totals are exact).
+            u = jax.random.uniform(kt, (n_feat, w_cap), dtype=dt)
+            thr_j = v_lo_j + u * (v_hi_j - v_lo_j)
+            thr_j = jnp.where(thr_j >= v_hi_j, v_lo_j, thr_j)  # sklearn guard
+            thr_s = jnp.take_along_axis(
+                thr_j, jnp.minimum(s_rel, w_cap - 1), axis=-1
             )
+            left_i = (s_val <= thr_s) & active
+            _, lw_tot = _prefix_stats(
+                jnp.where(left_i, s_w, 0.0), seg_start, seg_end
+            )
+            _, lwy_tot = _prefix_stats(
+                jnp.where(left_i, s_wy, 0.0), seg_start, seg_end
+            )
+            lw_j = gather_j(lw_tot)
+            lwy_j = gather_j(lwy_tot)
+            valid_j = nc_j & (lw_j > 0) & (tot_w_j - lw_j > 0)
+            score_j = _proxy_score(
+                lw_j, lwy_j, tot_w_j - lw_j, tot_wy_j - lwy_j, valid_j
+            )
+            lw_best_src, lwy_best_src = lw_j, lwy_j
         else:
-            score, thr, nc = _best_exact_splits(
-                sample_node, w, wy, order0, xsorted, tot_w, tot_wy, m
+            # Exact best splits: every between-values position in a run is a
+            # candidate; leftmost-best via a segmented suffix scan.
+            rw = tot_w - lw_pre
+            rwy = tot_wy - lwy_pre
+            valid = (
+                active
+                & (iota < seg_end)
+                & (v_next - s_val > FEATURE_EPS)
+                & (lw_pre > 0)
+                & (rw > 0)
             )
+            score_i = _proxy_score(lw_pre, lwy_pre, rw, rwy, valid)
+            best_s, best_p = _segmented_suffix_best(s_rel, score_i, n)
+            score_j = gather_j(best_s)
+            bpos_j = gather_j(best_p)
+            v_lo = jnp.take_along_axis(s_val, bpos_j, axis=-1)
+            v_hi = jnp.take_along_axis(v_next, bpos_j, axis=-1)
+            thr_j = (v_lo + v_hi) / 2.0
+            thr_j = jnp.where(thr_j == v_hi, v_lo, thr_j)  # midpoint guard
+            lw_best_src = jnp.take_along_axis(lw_pre, bpos_j, axis=-1)
+            lwy_best_src = jnp.take_along_axis(lwy_pre, bpos_j, axis=-1)
+            score_j = jnp.where(jnp.isfinite(score_j), score_j, -jnp.inf)
 
-        sel = _select_features(nc.T, kf, max_features)  # [M1, F]
-        score = jnp.where(sel.T, score, -jnp.inf)
-        best_f = jnp.argmax(score, axis=0).astype(jnp.int32)  # [M1]
-        best_score = jnp.max(score, axis=0)
-        thr_node = jnp.take_along_axis(thr, best_f[None, :], axis=0)[0]
+        # ---- choose feature per node (sklearn random feature draw) --------
+        sel = _select_features(nc_j.transpose(1, 0), kf, max_features)
+        score_j = jnp.where(sel.transpose(1, 0), score_j, -jnp.inf)
+        best_f = jnp.argmax(score_j, axis=0).astype(jnp.int32)      # [W]
+        best_score = jnp.max(score_j, axis=0)
 
-        ids = jnp.arange(m + 1)
-        can_split = jnp.isfinite(best_score) & impure & (ids < m)
+        def pick_f(a):                                   # [F, W] -> [W]
+            return jnp.take_along_axis(a, best_f[None, :], axis=0)[0]
+
+        thr_node = pick_f(thr_j)
+        lw_b = pick_f(lw_best_src)
+        lwy_b = pick_f(lwy_best_src)
+        tot_w_b = pick_f(tot_w_j)
+        tot_wy_b = pick_f(tot_wy_j)
+        node_present = pick_f(present.astype(jnp.int32)) > 0
+
+        impure = (tot_wy_b > 0) & (tot_w_b - tot_wy_b > 0)
+        can_split = jnp.isfinite(best_score) & impure & node_present
         rank = _exclusive_cumsum(can_split.astype(jnp.int32))
-        left_id = n_nodes + 2 * rank
-        right_id = left_id + 1
-        can_split = can_split & (right_id < m)  # capacity guard (never hit
-        # when max_nodes >= 2 * n_live_samples, the default)
+        left_g = n_nodes + 2 * rank
+        right_g = left_g + 1
+        can_split = can_split & (right_g < max_nodes)    # capacity guard
+        k_splits = jnp.sum(can_split, dtype=jnp.int32)
 
-        cs = can_split[:m]
-        feature = jnp.where(cs, best_f[:m], feature)
-        threshold = jnp.where(cs, thr_node[:m].astype(dt), threshold)
-        left = jnp.where(cs, left_id[:m].astype(jnp.int32), left)
-        right = jnp.where(cs, right_id[:m].astype(jnp.int32), right)
-        n_nodes = n_nodes + 2 * jnp.sum(can_split, dtype=jnp.int32)
-
-        node_s = sample_node
-        moving = can_split[node_s] & (w > 0)
-        f_s = best_f[node_s]
-        go_left = jnp.take_along_axis(x, f_s[:, None], axis=1)[:, 0] <= (
-            thr_node[node_s]
+        # ---- frontier window writes (contiguous ids, no scatter) ----------
+        feature = _window_update(
+            feature, level_base, jnp.where(can_split, best_f, -1), can_split
         )
-        child = jnp.where(go_left, left_id[node_s], right_id[node_s])
-        sample_node = jnp.where(moving, child, node_s).astype(jnp.int32)
+        threshold = _window_update(
+            threshold, level_base, thr_node, can_split
+        )
+        left = _window_update(
+            left, level_base, jnp.where(can_split, left_g, -1), can_split
+        )
+        right = _window_update(
+            right, level_base, jnp.where(can_split, right_g, -1), can_split
+        )
 
-        return feature, threshold, left, right, value, n_nodes, sample_node
+        # ---- child cover values, written at creation ----------------------
+        # Child slot s in [0, 2k): rank r = s//2; invert monotone ``rank``
+        # with searchsorted to find the r-th splitting frontier slot.
+        child_slots = jnp.arange(2 * w_cap, dtype=jnp.int32)
+        r_of_slot = child_slots // 2
+        csum = jnp.cumsum(can_split.astype(jnp.int32))
+        j_of_slot = jnp.searchsorted(
+            csum, r_of_slot + 1, side="left"
+        ).astype(jnp.int32)
+        j_safe = jnp.minimum(j_of_slot, w_cap - 1)
+        is_right = (child_slots % 2) == 1
+        lw_s = lw_b[j_safe]
+        lwy_s = lwy_b[j_safe]
+        tw_s = tot_w_b[j_safe]
+        twy_s = tot_wy_b[j_safe]
+        cw_s = jnp.where(is_right, tw_s - lw_s, lw_s)
+        cwy_s = jnp.where(is_right, twy_s - lwy_s, lwy_s)
+        child_ok = child_slots < 2 * k_splits
+        child_vals = jnp.stack([cw_s - cwy_s, cwy_s], axis=-1)
+        value = _window_update(value, n_nodes, child_vals, child_ok[:, None])
 
-    state = (feature, threshold, left, right, value, n_nodes, sample_node)
-    state = lax.fori_loop(0, max_depth, level, state)
-    feature, threshold, left, right, value, n_nodes, sample_node = state
+        # ---- route samples to children / park finished nodes --------------
+        rel_safe = jnp.minimum(sample_rel, w_cap - 1)
+        splits_mine = can_split[rel_safe] & (sample_rel < park)
+        bf_mine = best_f[rel_safe]
+        xv = jnp.take_along_axis(x, bf_mine[:, None], axis=1)[:, 0]
+        go_left = xv <= thr_node[rel_safe]
+        child_rel = 2 * rank[rel_safe] + jnp.where(go_left, 0, 1)
+        sample_rel = jnp.where(
+            splits_mine, child_rel, park
+        ).astype(jnp.int32)
 
-    # Children created on the final level have had no value-recording pass yet
-    # (the loop records counts at the *start* of each level); one last
-    # segment_sum fills them so every reachable leaf has a distribution.
-    tot_w = jax.ops.segment_sum(w, sample_node, num_segments=m + 1)
-    tot_wy = jax.ops.segment_sum(wy, sample_node, num_segments=m + 1)
-    counts = jnp.stack([tot_w - tot_wy, tot_wy], axis=-1)[:m]
-    value = jnp.where(tot_w[:m, None] > 0, counts, value)
+        return (feature, threshold, left, right, value,
+                n_nodes + 2 * k_splits, n_nodes, sample_rel, d + 1)
 
-    return feature, threshold, left, right, value, n_nodes
+    def cond(state):
+        n_nodes, level_base, d = state[5], state[6], state[8]
+        return (d < max_depth) & (n_nodes > level_base)
+
+    state = (feature, threshold, left, right, value, jnp.int32(1),
+             jnp.int32(0), sample_rel, jnp.int32(0))
+    state = lax.while_loop(cond, level, state)
+    feature, threshold, left, right, value = state[:5]
+    n_nodes = state[5]
+
+    return (feature[:max_nodes], threshold[:max_nodes], left[:max_nodes],
+            right[:max_nodes], value[:max_nodes], n_nodes)
 
 
 def _bootstrap_weights(w, key):
@@ -323,10 +417,10 @@ def fit_forest(x, y, w, key, *, n_trees, bootstrap, random_splits,
 
     ``tree_chunk`` bounds how many trees grow concurrently: trees ride an
     inner vmap of that width under a sequential ``lax.map`` over chunks.
-    The per-level split-search workspace is O(trees_in_flight x F x
-    max_nodes); an unchunked 100-tree x 10-fold ensemble fit overruns TPU
-    device memory, so sweep-level callers pass a chunk (results are
-    identical — per-tree PRNG keys don't depend on the chunking).
+    The per-level workspace is O(trees_in_flight x F x N); an unchunked
+    100-tree x 10-fold ensemble fit overruns TPU device memory, so
+    sweep-level callers pass a chunk (results are identical — per-tree PRNG
+    keys don't depend on the chunking).
     """
     n, f = x.shape
     if max_nodes is None:
@@ -336,11 +430,10 @@ def fit_forest(x, y, w, key, *, n_trees, bootstrap, random_splits,
     y01 = y.astype(x.dtype)
     w = w.astype(x.dtype)
 
-    if random_splits:
-        order0 = xsorted = None
-    else:
-        order0 = jnp.argsort(x.T, axis=1, stable=True).astype(jnp.int32)
-        xsorted = jnp.take_along_axis(x.T, order0, axis=1)
+    # Per-feature value order, shared by every tree (weights never reorder
+    # values; parked rows are handled by the per-level node key).
+    order0 = jnp.argsort(x.T, axis=1, stable=True).astype(jnp.int32)
+    xsorted = jnp.take_along_axis(x.T, order0, axis=1)
 
     keys = jax.random.split(key, n_trees)
 
