@@ -1,25 +1,36 @@
 """Benchmark: TPU sweep vs single-host sklearn on the probe configs.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} (last line of
+stdout), whatever happens to the device.
 
 Baseline (BASELINE.md): the reference publishes no numbers, so the baseline is
 self-measured — the same configs on the single-host CPU stack the reference
 uses (sklearn trees; the resampling steps use this repo's numpy oracles since
 imbalanced-learn is not installed here, matching imblearn 0.9 semantics).
-Ours: the jitted JAX sweep on the default backend (the real TPU chip under the
-driver; compile time excluded — the sweep reuses one compiled graph per model
-family, so per-config steady-state time is what scales to the 216-config grid).
+Ours: the jitted JAX sweep, steady-state (one compiled graph per model family
+serves all configs of that family across the full 216-config grid, so
+compile time is excluded).
+
+Robustness: the accelerator runs in a SUBPROCESS. The TPU tunnel in this
+environment can fault or wedge on oversized dispatches (see
+ops/trees.py docstring); a crashed subprocess must not take the bench down,
+so the parent probes device health first, retries once, and falls back to
+measuring the same JAX pipeline on CPU (reported honestly via
+``detail.backend``) rather than emitting nothing.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
 N_TESTS = int(os.environ.get("BENCH_N_TESTS", "2000"))
 SEED = 7
+WORKER_TIMEOUT_S = int(os.environ.get("BENCH_WORKER_TIMEOUT_S", "540"))
 
 # Probe configs (BASELINE.json "configs" №1-3 + family coverage).
 CONFIGS = [
@@ -30,6 +41,17 @@ CONFIGS = [
     ("OD", "Flake16", "None", "Tomek Links", "Decision Tree"),
     ("OD", "FlakeFlagger", "Scaling", "SMOTE", "Random Forest"),
 ]
+
+
+def make_data():
+    from flake16_framework_tpu.utils.synth import make_dataset
+
+    feats, labels, pids = make_dataset(n_tests=N_TESTS, seed=SEED)
+    names = [f"project{p:02d}" for p in range(26)]
+    import numpy as np
+
+    projects = np.array([names[p] for p in pids])
+    return feats, labels, projects, names, pids
 
 
 def sklearn_baseline(feats, labels_raw, configs):
@@ -44,7 +66,7 @@ def sklearn_baseline(feats, labels_raw, configs):
     from sklearn.pipeline import Pipeline
     from sklearn.model_selection import StratifiedKFold
 
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "tests"))
+    sys.path.insert(0, os.path.join(REPO, "tests"))
     from ref_resamplers import tomek_keep_ref, enn_keep_ref
 
     from flake16_framework_tpu import config as cfg
@@ -96,8 +118,9 @@ def sklearn_baseline(feats, labels_raw, configs):
                                  ("p", PCA(random_state=0))]),
     }
 
-    t0 = time.time()
+    times = []
     for keys in configs:
+        t0 = time.time()
         fl_name, fs_name, prep_name, bal_name, model_name = keys
         fl = cfg.FLAKY_TYPES[fl_name]
         cols = list(cfg.FEATURE_SETS[fs_name])
@@ -110,13 +133,21 @@ def sklearn_baseline(feats, labels_raw, configs):
             xb, yb = balance(bal_name, x[tr], y[tr])
             m = models[model_name]().fit(xb, yb)
             m.predict(x[te])
-    return time.time() - t0
+        times.append(time.time() - t0)
+    return times
 
 
-def tpu_sweep(feats, labels_raw, projects, names, pids, configs):
+def worker(config_idx):
+    """Subprocess body: run the jitted sweep on the default backend for the
+    given CONFIGS subset and print one JSON line {"t_ours": seconds}."""
+    import jax  # noqa: F401  (device init happens here, inside the sandbox)
+
     from flake16_framework_tpu.parallel.sweep import SweepEngine
 
-    engine = SweepEngine(feats, labels_raw, projects, names, pids)
+    configs = [CONFIGS[i] for i in config_idx]
+    feats, labels, projects, names, pids = make_data()
+    engine = SweepEngine(feats, labels, projects, names, pids)
+
     # Warm-up: compile each family graph once (steady-state measurement —
     # one compile serves all configs of a family across the full 216 grid).
     seen = set()
@@ -125,33 +156,115 @@ def tpu_sweep(feats, labels_raw, projects, names, pids, configs):
         if fam not in seen:
             engine.run_config(keys)
             seen.add(fam)
+            print(f"warmed {fam}", file=sys.stderr, flush=True)
 
     t0 = time.time()
     for keys in configs:
         engine.run_config(keys)
-    return time.time() - t0
+    print(json.dumps({"t_ours": time.time() - t0, "backend":
+                      jax.default_backend()}), flush=True)
+
+
+def probe():
+    """Quick device sanity check in a subprocess (the tunnel can hang).
+
+    Also requires a non-CPU default backend: if JAX silently comes up
+    CPU-only, the full-ensemble worker would burn both timeouts on a sweep
+    the CPU can't finish — route straight to the DT fallback instead."""
+    code = ("import jax, jax.numpy as jnp;"
+            "x = jnp.ones((256, 256));"
+            "assert jax.default_backend() != 'cpu', 'cpu-only';"
+            "print(float((x @ x)[0, 0]))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=120,
+                           capture_output=True, text=True, cwd=REPO)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_worker(config_idx, env_extra=None):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             ",".join(map(str, config_idx))],
+            timeout=WORKER_TIMEOUT_S, capture_output=True, text=True,
+            cwd=REPO, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None, "timeout"
+    if r.returncode != 0:
+        return None, (r.stderr or "")[-400:]
+    try:
+        return json.loads(r.stdout.strip().splitlines()[-1]), None
+    except Exception:
+        return None, (r.stdout or "")[-400:]
+
+
+DT_IDX = [i for i, k in enumerate(CONFIGS) if k[4] == "Decision Tree"]
 
 
 def main():
-    from flake16_framework_tpu.utils.synth import make_dataset
-
-    feats, labels, pids = make_dataset(n_tests=N_TESTS, seed=SEED)
-    names = [f"project{p:02d}" for p in range(26)]
-    projects = __import__("numpy").array([names[p] for p in pids])
-
+    feats, labels, projects, names, pids = make_data()
     t_base = sklearn_baseline(feats, labels, CONFIGS)
-    t_ours = tpu_sweep(feats, labels, projects, names, pids, CONFIGS)
 
-    speedup = t_base / t_ours if t_ours > 0 else float("inf")
+    detail = {"t_sklearn_s": round(sum(t_base), 2), "n_tests": N_TESTS}
+    result, err = None, None
+    idx = list(range(len(CONFIGS)))
+    tag = f"scores_probe_sweep_{len(CONFIGS)}cfg_n{N_TESTS}"
+
+    if os.environ.get("BENCH_DEVICE") == "cpu":
+        detail["tpu_probe"] = "disabled"  # operator opt-out, not a failure
+    elif not probe():
+        detail["tpu_probe"] = "unreachable"
+    else:
+        result, err = run_worker(idx)
+        if result is None:
+            detail["tpu_attempt_1"] = err
+            result, err = run_worker(idx)  # faults can be transient
+            if result is None:
+                detail["tpu_attempt_2"] = err
+
+    if result is None:
+        # Fallback: the two Decision Tree configs on the CPU backend — the
+        # ensembles are too slow to compile+run on CPU within the bench
+        # budget, but a DT-only subset still yields a real end-to-end
+        # measurement against the matching sklearn subset (reported
+        # honestly via the metric name + detail.backend).
+        idx = DT_IDX
+        tag = f"scores_probe_dt_{len(idx)}cfg_n{N_TESTS}"
+        result, err = run_worker(idx, {
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "XLA_FLAGS": os.environ.get("XLA_FLAGS", ""),
+        })
+        if result is None:
+            print(json.dumps({
+                "metric": tag + "_speedup",
+                "value": 0.0, "unit": "x_vs_single_host_sklearn",
+                "vs_baseline": 0.0,
+                "detail": {**detail, "error": err},
+            }))
+            return
+
+    t_ours = result["t_ours"]
+    t_sk = sum(t_base[i] for i in idx)
+    speedup = t_sk / t_ours if t_ours > 0 else float("inf")
+    detail.update(t_ours_s=round(t_ours, 2), t_sklearn_subset_s=round(t_sk, 2),
+                  backend=result.get("backend"))
     print(json.dumps({
-        "metric": f"scores_probe_sweep_{len(CONFIGS)}cfg_n{N_TESTS}_speedup",
+        "metric": tag + "_speedup",
         "value": round(speedup, 3),
         "unit": "x_vs_single_host_sklearn",
         "vs_baseline": round(speedup, 3),
-        "detail": {"t_sklearn_s": round(t_base, 2),
-                   "t_tpu_s": round(t_ours, 2)},
+        "detail": detail,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        worker([int(i) for i in sys.argv[2].split(",")])
+    else:
+        main()
