@@ -172,15 +172,17 @@ def probe():
     CPU-only, the full-ensemble worker would burn both timeouts on a sweep
     the CPU can't finish — route straight to the DT fallback instead."""
     code = ("import jax, jax.numpy as jnp;"
+            "assert jax.default_backend() != 'cpu', 'cpu-only backend';"
             "x = jnp.ones((256, 256));"
-            "assert jax.default_backend() != 'cpu', 'cpu-only';"
             "print(float((x @ x)[0, 0]))")
     try:
         r = subprocess.run([sys.executable, "-c", code], timeout=120,
                            capture_output=True, text=True, cwd=REPO)
-        return r.returncode == 0
+        if r.returncode == 0:
+            return True, None
+        return False, (r.stderr or "")[-200:]
     except subprocess.TimeoutExpired:
-        return False
+        return False, "probe timeout (tunnel wedged?)"
 
 
 def run_worker(config_idx, env_extra=None):
@@ -217,9 +219,12 @@ def main():
 
     if os.environ.get("BENCH_DEVICE") == "cpu":
         detail["tpu_probe"] = "disabled"  # operator opt-out, not a failure
-    elif not probe():
-        detail["tpu_probe"] = "unreachable"
+        probe_ok = False
     else:
+        probe_ok, probe_err = probe()
+        if not probe_ok:
+            detail["tpu_probe"] = probe_err  # wedged tunnel vs cpu-only etc.
+    if probe_ok:
         result, err = run_worker(idx)
         if result is None:
             detail["tpu_attempt_1"] = err
@@ -237,8 +242,7 @@ def main():
         tag = f"scores_probe_dt_{len(idx)}cfg_n{N_TESTS}"
         result, err = run_worker(idx, {
             "JAX_PLATFORMS": "cpu",
-            "PALLAS_AXON_POOL_IPS": "",
-            "XLA_FLAGS": os.environ.get("XLA_FLAGS", ""),
+            "PALLAS_AXON_POOL_IPS": "",  # empty disables the tunnel hook
         })
         if result is None:
             print(json.dumps({

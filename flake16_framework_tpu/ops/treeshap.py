@@ -26,6 +26,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 
 def extract_paths(feature, threshold, left, right, value, max_depth):
@@ -209,15 +211,27 @@ def tree_shap_single(paths, x, n_features):
     return phi
 
 
-def forest_shap_class0(forest, x, *, sample_chunk=None):
+def forest_shap_class0(forest, x, *, sample_chunk=None, impl="auto"):
     """Mean over trees of per-tree class-0 Tree SHAP — the ensemble
     soft-vote's probability decomposition (what shap_values(X)[0] returns for
     a sklearn forest).
 
-    forest: trees.Forest with [T, ...] axes. Trees run under lax.map so only
-    one tree's O(L*S*F) workspace is live; chunk samples via ``sample_chunk``
-    if even that is too large.
+    forest: trees.Forest with [T, ...] axes.
+
+    ``impl``: "pallas" (the TPU kernel below), "xla" (the lax.map/vmap
+    formulation above), or "auto" — pallas on TPU, xla elsewhere (the kernel
+    runs anywhere via the Pallas interpreter, but interpret mode is only
+    meant for tests). For "xla", trees run under lax.map so only one tree's
+    O(L*S*F) workspace is live; chunk samples via ``sample_chunk`` if even
+    that is too large.
     """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "pallas":
+        return _pallas_forest_shap(forest, x)
+    if impl != "xla":
+        raise ValueError(f"unknown Tree SHAP impl {impl!r}")
+
     n_features = x.shape[1]
     t = forest.feature.shape[0]
     depth = int(forest.max_depth)
@@ -242,6 +256,203 @@ def forest_shap_class0(forest, x, *, sample_chunk=None):
          forest.value),
     )
     return jnp.mean(phis, axis=0)
+
+
+# --------------------------------------------------------------------------
+# Pallas TPU kernel
+# --------------------------------------------------------------------------
+#
+# Layout (north star: "rewrite shap.TreeExplainer's tree-path-dependent value
+# computation as a Pallas kernel"; parallelization over (tree, sample) blocks
+# is the GPUTreeShap decomposition — PAPERS.md):
+#
+#   grid = (sample_block, tree, leaf_block); the output block [F, SBLK]
+#   depends only on the sample block, so the (tree, leaf) dims accumulate
+#   into a resident VMEM block. Samples ride the 128-wide lane axis; the
+#   EXTEND weight vector rides sublanes ([F+2, SBLK] tiles). A leaf's D path
+#   steps are merged into per-feature (zero fraction, one fraction) with
+#   three tiny [F, D] x [D, SBLK] MXU matmuls (one-hot selects instead of
+#   gathers, which TPU lacks along sublanes). Per-tree real-leaf counts are
+#   scalar-prefetched so padded leaf blocks predicate off.
+
+_SBLK = 128
+_LBLK = 8
+
+
+def _shap_kernel(n_leaves_ref, sf, sthr, sratio, sleft, svalid, leaf_p0,
+                 leaf_ok, xt, out, *, n_features, depth):
+    sb, t, lb = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    f32 = jnp.float32
+    fp2 = n_features + 2
+
+    @pl.when((t == 0) & (lb == 0))
+    def _():
+        out[:] = jnp.zeros_like(out)
+
+    block_has_leaves = lb * _LBLK < n_leaves_ref[t]
+
+    @pl.when(block_has_leaves)
+    def _():
+        x_fs = xt[:]                                   # [F, SBLK]
+        iota_f = lax.broadcasted_iota(jnp.int32, (n_features, depth), 0)
+        iota_i = lax.broadcasted_iota(f32, (fp2, 1), 0)
+
+        def one_leaf(leaf, acc):
+            sf_l = sf[0, leaf, :]                      # [D] i32
+            onehot_fd = (sf_l[None, :] == iota_f) & (
+                svalid[0, leaf, :][None, :] > 0
+            )
+            onehot_fd = onehot_fd.astype(f32)          # [F, D]
+
+            # Merged per-feature fractions: z (cover products, via logs),
+            # presence, and the per-sample one-fraction o (AND of branch
+            # indicators along the path, via a zero count).
+            logr = jnp.log(jnp.maximum(sratio[0, leaf, :], 1e-30))
+            z = jnp.exp(
+                jnp.dot(onehot_fd, logr[:, None],
+                        preferred_element_type=f32)
+            )                                          # [F, 1]
+            present = (
+                jnp.dot(onehot_fd, jnp.ones((depth, 1), f32),
+                        preferred_element_type=f32) > 0
+            )                                          # [F, 1]
+
+            x_sel = jnp.dot(onehot_fd.T, x_fs,
+                            preferred_element_type=f32)  # [D, SBLK]
+            goes_left = x_sel <= sthr[0, leaf, :][:, None]
+            ind = jnp.where(sleft[0, leaf, :][:, None] > 0, goes_left,
+                            ~goes_left)
+            miss = jnp.dot(onehot_fd, 1.0 - ind.astype(f32),
+                           preferred_element_type=f32)
+            o = (miss == 0).astype(f32)                # [F, SBLK]
+
+            # EXTEND: fold each present feature into the permutation-weight
+            # vector w [F+2, SBLK]; path length l is sample-independent.
+            w0 = jnp.zeros((fp2, _SBLK), f32).at[0, :].set(1.0)
+
+            def ext(f, carry):
+                w, l = carry
+                pf = present[f, 0]
+                zf = z[f, 0]
+                of = o[f, :][None, :]                  # [1, SBLK]
+                stay = zf * w * (l - iota_i) / (l + 1.0)
+                w_shift = jnp.concatenate(
+                    [jnp.zeros((1, _SBLK), f32), w[:-1, :]], axis=0
+                )
+                up = of * w_shift * iota_i / (l + 1.0)
+                return (jnp.where(pf, stay + up, w),
+                        jnp.where(pf, l + 1.0, l))
+
+            w, l = lax.fori_loop(0, n_features, ext, (w0, jnp.float32(1.0)))
+
+            # UNWIND all features at once, j from high to low; total is the
+            # sum of unwound weights, phi_f = (o_f - z_f) * total * leaf_p0.
+            li = (l - 1.0).astype(jnp.int32)
+            nxt0 = jnp.broadcast_to(w[li, :][None, :],
+                                    (n_features, _SBLK))
+            zb = jnp.broadcast_to(z, (n_features, _SBLK))
+            zb = jnp.maximum(zb, 1e-30)
+
+            def unwind(jj, carry):
+                total, nxt = carry
+                j = jnp.float32(fp2 - 2) - jj          # static countdown
+                activ = (j <= l - 2.0)
+                wj = jnp.broadcast_to(w[j.astype(jnp.int32), :][None, :],
+                                      (n_features, _SBLK))
+                o_safe = jnp.where(o == 0, 1.0, o)
+                tmp = nxt * l / ((j + 1.0) * o_safe)
+                total_o = total + tmp
+                nxt_o = wj - tmp * zb * (l - 1.0 - j) / l
+                total_z = total + wj * l / (zb * (l - 1.0 - j))
+                tot_new = jnp.where(o == 0, total_z, total_o)
+                nxt_new = jnp.where(o == 0, nxt, nxt_o)
+                total = jnp.where(activ, tot_new, total)
+                nxt = jnp.where(activ, nxt_new, nxt)
+                return total, nxt
+
+            total, _ = lax.fori_loop(
+                0, fp2 - 1, unwind,
+                (jnp.zeros((n_features, _SBLK), f32), nxt0),
+            )
+
+            scale = leaf_p0[0, leaf] * leaf_ok[0, leaf]
+            contrib = jnp.where(
+                present & (l > 1.0), (o - zb) * total * scale, 0.0
+            )
+            return acc + contrib
+
+        acc = lax.fori_loop(
+            0, _LBLK, one_leaf, jnp.zeros((n_features, _SBLK), f32)
+        )
+        out[:] += acc
+
+
+def _pallas_forest_shap(forest, x, *, interpret=None):
+    """[F, S]-accumulating Pallas launch over (sample, tree, leaf) blocks;
+    returns the per-sample mean over trees, transposed to [S, F]."""
+    t, m = forest.feature.shape
+    s, n_features = x.shape
+    depth = int(forest.max_depth)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # Pad the feature (sublane) axis to the f32 tile minimum; padded feature
+    # rows never match a path step (their one-hot rows stay empty), so their
+    # contributions are exactly zero and are sliced off at the end.
+    n_feat_k = max(8, n_features + (-n_features) % 8)
+
+    paths = jax.vmap(
+        lambda fe, th, le, ri, va: extract_paths(fe, th, le, ri, va, depth)
+    )(forest.feature, forest.threshold, forest.left, forest.right,
+      forest.value)
+
+    l_slots = paths["sf"].shape[1]
+    l_pad = (-l_slots) % _LBLK
+    s_pad = (-s) % _SBLK
+
+    def pad_l(a, fill=0):
+        return jnp.pad(a, ((0, 0), (0, l_pad)) + ((0, 0),) * (a.ndim - 2),
+                       constant_values=fill)
+
+    sf = pad_l(paths["sf"]).astype(jnp.int32)
+    sthr = pad_l(paths["sthr"]).astype(jnp.float32)
+    sratio = pad_l(paths["sratio"], 1).astype(jnp.float32)
+    sleft = pad_l(paths["sleft"]).astype(jnp.int32)
+    svalid = pad_l(paths["svalid"]).astype(jnp.int32)
+    leaf_p0 = pad_l(paths["leaf_p0"]).astype(jnp.float32)
+    leaf_ok = pad_l(paths["leaf_ok"]).astype(jnp.float32)
+    n_leaves = jnp.sum(paths["leaf_ok"], axis=1).astype(jnp.int32)  # [T]
+
+    xt = jnp.pad(x.T.astype(jnp.float32),
+                 ((0, n_feat_k - n_features), (0, s_pad)))
+
+    lt = (l_slots + l_pad) // _LBLK
+    st = (s + s_pad) // _SBLK
+
+    # Index maps receive the scalar-prefetch ref as a trailing argument.
+    path_spec = pl.BlockSpec(
+        (1, _LBLK, depth), lambda sb, t_, lb, nl: (t_, lb, 0)
+    )
+    leaf_spec = pl.BlockSpec((1, _LBLK), lambda sb, t_, lb, nl: (t_, lb))
+
+    out = pl.pallas_call(
+        functools.partial(_shap_kernel, n_features=n_feat_k, depth=depth),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(st, t, lt),
+            in_specs=[
+                path_spec, path_spec, path_spec, path_spec, path_spec,
+                leaf_spec, leaf_spec,
+                pl.BlockSpec((n_feat_k, _SBLK),
+                             lambda sb, t_, lb, nl: (0, sb)),
+            ],
+            out_specs=pl.BlockSpec((n_feat_k, _SBLK),
+                                   lambda sb, t_, lb, nl: (0, sb)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_feat_k, s + s_pad), jnp.float32),
+        interpret=interpret,
+    )(n_leaves, sf, sthr, sratio, sleft, svalid, leaf_p0, leaf_ok, xt)
+
+    return out[:n_features, :s].T / t
 
 
 def expected_p0(forest):

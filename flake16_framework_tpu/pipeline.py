@@ -93,11 +93,13 @@ def _dump(obj, path):
 
 
 def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
-                    tree_overrides=None, seed=0, sample_chunk=512):
+                    tree_overrides=None, seed=0, sample_chunk=512,
+                    impl="auto"):
     """One SHAP config (reference get_shap experiment.py:504-517): preprocess
     full data, fit on the balanced full set, explain every original sample.
     Returns the class-0 values array [N, F'] (the reference's
-    ``shap_values(features)[0]`` convention)."""
+    ``shap_values(features)[0]`` convention). ``impl`` selects the Tree SHAP
+    backend (ops/treeshap.py: "pallas" kernel / "xla" / "auto")."""
     fl, cols, prep, bal, spec = cfg.resolve_config(config_keys)
     if tree_overrides and spec.name in tree_overrides:
         spec = type(spec)(spec.name, tree_overrides[spec.name], spec.bootstrap,
@@ -123,18 +125,19 @@ def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
                        if spec.n_trees % c == 0),
     )
     return np.asarray(
-        treeshap.forest_shap_class0(forest, xp, sample_chunk=sample_chunk)
+        treeshap.forest_shap_class0(forest, xp, sample_chunk=sample_chunk,
+                                    impl=impl)
     )
 
 
 def write_shap(tests_file=TESTS_FILE, out_file=SHAP_FILE, *, max_depth=48,
-               tree_overrides=None, sample_chunk=512):
+               tree_overrides=None, sample_chunk=512, impl="auto"):
     """The two paper configs (reference write_shap experiment.py:520-530)."""
     feats, labels, _, _, _ = _load_arrays(tests_file)
     values = [
         shap_for_config(keys, feats, labels, max_depth=max_depth,
                         tree_overrides=tree_overrides,
-                        sample_chunk=sample_chunk)
+                        sample_chunk=sample_chunk, impl=impl)
         for keys in cfg.SHAP_CONFIGS
     ]
     with open(out_file, "wb") as fd:

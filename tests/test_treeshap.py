@@ -116,6 +116,27 @@ def test_sample_chunking_matches():
     np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-9)
 
 
+@pytest.mark.parametrize("f", [6, 16])
+def test_pallas_kernel_matches_xla(f):
+    # The Pallas TPU kernel (run here through the Pallas interpreter) must
+    # reproduce the XLA formulation on a mixed forest: bootstrap weights,
+    # uneven tree sizes, sample-count not a lane multiple, and both the
+    # Flake16 width (16) and a feature count below the sublane minimum
+    # (exercises the padding paths).
+    rng = np.random.RandomState(7)
+    n = 90
+    x = rng.randn(n, f)
+    y = (x[:, 1] - x[:, 2] + 0.5 * rng.randn(n)) > 0
+    forest = fit_forest(
+        x, y, np.ones(n), jax.random.PRNGKey(2), n_trees=5, bootstrap=True,
+        random_splits=True, sqrt_features=True, max_depth=9, max_nodes=256,
+    )
+    xq = rng.randn(37, f)
+    a = np.asarray(forest_shap_class0(forest, xq, impl="xla"))
+    b = np.asarray(forest_shap_class0(forest, xq, impl="pallas"))
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
 def test_extract_paths_ratios():
     # Hand-built stump: root splits f0 at 0; covers 3/7 left, 4/7 right.
     import jax.numpy as jnp
