@@ -1,0 +1,23 @@
+"""Dependency-free pytest plugins: the data-collection instruments.
+
+The reference consumes two pytest plugins that live in git submodules it does
+not ship (empty dirs in the mount — SURVEY.md §2 rows 8-9; ``.gitmodules``):
+
+- **showflakes** — per-test outcome recording with optional order shuffling
+  (flags ``--record-file=<f>.tsv``, ``--shuffle``, ``--set-exitstatus``;
+  invoked at reference ``experiment.py:153-158``, output parsed at
+  ``:260-277``).
+- **testinspect** — one instrumented run emitting ``<f>.sqlite3`` (per-test
+  dynamic-context line coverage), ``<f>.tsv`` (6 rusage floats + nodeid) and
+  ``<f>.pkl`` (static features + test files + per-line git churn); invoked at
+  ``experiment.py:156``, outputs parsed at ``:280-313``.
+
+These are ground-up implementations of those CLI/output contracts, written to
+install into arbitrary subject virtualenvs: stdlib + psutil only — no
+coverage.py (line tracing is ``sys.monitoring``), no radon (static metrics
+are an ``ast`` walk), and no import of this package's JAX stack.
+
+Enable with ``-p flake16_framework_tpu.plugins.showflakes`` /
+``-p flake16_framework_tpu.plugins.testinspect`` (or install the package into
+the subject venv and pass the same flags the reference passes).
+"""

@@ -1,0 +1,316 @@
+"""The two data-collection pytest plugins, driven on real toy suites
+(the reference ships neither plugin — SURVEY.md §2 rows 8-9 define the
+contracts; these tests close the loop through runner/collate's ingestors)."""
+
+import os
+import pickle
+import sqlite3
+import subprocess
+import textwrap
+
+import pytest
+
+from flake16_framework_tpu.plugins.churn import git_churn
+from flake16_framework_tpu.plugins.static_features import ModuleAnalyzer
+from flake16_framework_tpu.plugins.testinspect import lines_to_numbits
+from flake16_framework_tpu.runner.collate import numbits_to_lines
+
+pytest_plugins = ["pytester"]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(pytester, *args):
+    # runpytest_subprocess inherits os.environ; splice the repo onto
+    # PYTHONPATH for the child and restore afterwards.
+    old = os.environ.get("PYTHONPATH")
+    os.environ["PYTHONPATH"] = REPO + (os.pathsep + old if old else "")
+    try:
+        return pytester.runpytest_subprocess(*args)
+    finally:
+        if old is None:
+            del os.environ["PYTHONPATH"]
+        else:
+            os.environ["PYTHONPATH"] = old
+
+
+@pytest.fixture
+def toy_suite(pytester):
+    pytester.makepyfile(
+        src=textwrap.dedent("""
+            def double(v):
+                return 2 * v
+
+            def triple(v):
+                return 3 * v
+        """),
+        test_toy=textwrap.dedent("""
+            import src
+
+            def test_double():
+                assert src.double(2) == 4
+
+            def test_triple():
+                assert src.triple(2) == 6
+
+            def test_fails():
+                assert src.double(1) == 3
+
+            def test_skipped():
+                import pytest
+                pytest.skip("nope")
+        """),
+    )
+    return pytester
+
+
+def test_showflakes_records_and_sets_exitstatus(toy_suite):
+    res = _run(
+        toy_suite, "-p", "flake16_framework_tpu.plugins.showflakes",
+        "--record-file=out.tsv", "--set-exitstatus",
+    )
+    assert res.ret == 0  # failures are data, not an error exit
+
+    rows = dict(
+        line.split("\t")[::-1]
+        for line in (toy_suite.path / "out.tsv").read_text().splitlines()
+    )
+    assert rows["test_toy.py::test_double"] == "passed"
+    assert rows["test_toy.py::test_fails"] == "failed"
+    assert rows["test_toy.py::test_skipped"] == "skipped"
+    assert len(rows) == 4
+
+
+def test_showflakes_shuffle_keeps_the_test_set(toy_suite):
+    res = _run(
+        toy_suite, "-p", "flake16_framework_tpu.plugins.showflakes",
+        "--record-file=out.tsv", "--shuffle", "--set-exitstatus",
+    )
+    assert res.ret == 0
+    lines = (toy_suite.path / "out.tsv").read_text().splitlines()
+    assert sorted(line.split("\t")[1] for line in lines) == [
+        "test_toy.py::test_double", "test_toy.py::test_fails",
+        "test_toy.py::test_skipped", "test_toy.py::test_triple",
+    ]
+
+
+def test_showflakes_exit_nonzero_without_set_exitstatus(toy_suite):
+    res = _run(
+        toy_suite, "-p", "flake16_framework_tpu.plugins.showflakes",
+        "--record-file=out.tsv",
+    )
+    assert res.ret == pytest.ExitCode.TESTS_FAILED
+
+
+def test_testinspect_artifacts(toy_suite):
+    res = _run(
+        toy_suite, "-p", "flake16_framework_tpu.plugins.testinspect",
+        "--testinspect=insp",
+    )
+    assert res.ret == pytest.ExitCode.TESTS_FAILED  # no --set-exitstatus
+
+    # rusage TSV: 6 floats + nodeid per test, FEATURE_NAMES[3:9] order.
+    lines = (toy_suite.path / "insp.tsv").read_text().splitlines()
+    rows = {}
+    for line in lines:
+        *vals, nid = line.split("\t", 6)
+        assert len(vals) == 6
+        rows[nid] = [float(v) for v in vals]
+    assert set(rows) == {
+        "test_toy.py::test_double", "test_toy.py::test_triple",
+        "test_toy.py::test_fails", "test_toy.py::test_skipped",
+    }
+    assert all(r[0] > 0 for r in rows.values())       # execution time
+    assert all(r[5] > 0 for r in rows.values())       # max rss
+
+    # coverage DB: per-test dynamic contexts over the toy source module.
+    con = sqlite3.connect(toy_suite.path / "insp.sqlite3")
+    contexts = dict(con.execute("SELECT context, id FROM context"))
+    files = dict(con.execute("SELECT id, path FROM file"))
+    cov = {}
+    for ctx_id, file_id, blob in con.execute(
+        "SELECT context_id, file_id, numbits FROM line_bits"
+    ):
+        nid = {v: k for k, v in contexts.items()}[ctx_id]
+        cov.setdefault(nid, {})[os.path.basename(files[file_id])] = (
+            numbits_to_lines(blob)
+        )
+    con.close()
+
+    src = (toy_suite.path / "src.py").read_text().splitlines()
+    double_line = next(i for i, l in enumerate(src, 1) if "2 * v" in l)
+    triple_line = next(i for i, l in enumerate(src, 1) if "3 * v" in l)
+    assert double_line in cov["test_toy.py::test_double"]["src.py"]
+    assert double_line not in cov["test_toy.py::test_triple"].get(
+        "src.py", set()
+    )
+    assert triple_line in cov["test_toy.py::test_triple"]["src.py"]
+
+    # static pickle: (fn ids, 7 features each, test files, churn).
+    with open(toy_suite.path / "insp.pkl", "rb") as fd:
+        fn_ids, fn_data, test_files, churn = pickle.load(fd)
+    assert set(fn_ids) == set(rows)
+    assert all(len(feats) == 7 for feats in fn_data.values())
+    assert "test_toy.py" in test_files
+    # one assertion each, positive LoC, maintainability in [0, 100]
+    feats = fn_data[fn_ids["test_toy.py::test_double"]]
+    assert feats[1] == 1.0 and feats[5] >= 2.0 and 0.0 <= feats[6] <= 100.0
+    assert churn == {}  # pytester tmp dir is not a git repo
+
+
+def test_full_collection_loop_to_tests_json(tmp_path):
+    """End-to-end L1->L3: run both plugins on a toy git subject across
+    baseline + shuffled campaigns, collate the contract-named artifacts, and
+    get a labeled tests.json — NON_FLAKY / OD (order-dependent pair) / NOD
+    (run-parity intermittent) all land correctly."""
+    from flake16_framework_tpu.constants import FLAKY, NON_FLAKY, OD_FLAKY
+    from flake16_framework_tpu.runner.collate import write_tests
+
+    subjects = tmp_path / "subjects"
+    checkout = subjects / "proj" / "proj"
+    data = tmp_path / "data"
+    data.mkdir(parents=True)
+    checkout.mkdir(parents=True)
+
+    (checkout / "pytest.ini").write_text("[pytest]\n")
+    # A subject conftest that seeds the global random module — the exact
+    # idiom the shuffle's private RNG must be immune to.
+    (checkout / "conftest.py").write_text("import random\nrandom.seed(0)\n")
+    # Definition order [test_a, test_b, test_nod, test_stable]: test_b
+    # passes iff test_a ran first (the order-dependent pair); test_nod fails
+    # on odd run numbers regardless of order.
+    (checkout / "test_toy.py").write_text(textwrap.dedent("""
+        import os
+
+        RAN_A = False
+
+        def test_a():
+            global RAN_A
+            RAN_A = True
+
+        def test_b():
+            assert RAN_A
+
+        def test_nod():
+            assert int(os.environ["TOY_RUN"]) % 2 == 0
+
+        def test_stable():
+            assert True
+    """))
+    for args in (["init", "-q"], ["add", "-A"],
+                 ["commit", "-qm", "c1"]):
+        subprocess.run(["git", *args], cwd=checkout, check=True,
+                       capture_output=True,
+                       env={**os.environ,
+                            "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t"})
+
+    def run_mode(mode, run_n, seed=0):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        env["TOY_RUN"] = str(run_n)
+        env["SHOWFLAKES_SEED"] = str(seed)
+        env.pop("PYTEST_ADDOPTS", None)
+        if mode == "testinspect":
+            args = ["-p", "flake16_framework_tpu.plugins.testinspect",
+                    f"--testinspect={data / f'proj_testinspect_{run_n}'}"]
+        else:
+            args = ["-p", "flake16_framework_tpu.plugins.showflakes",
+                    f"--record-file={data / f'proj_{mode}_{run_n}'}.tsv",
+                    "--set-exitstatus"]
+            if mode == "shuffle":
+                args.append("--shuffle")
+        r = subprocess.run(
+            ["python", "-m", "pytest", "-q", *args],
+            cwd=checkout, env=env, capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    for run_n in range(4):
+        run_mode("baseline", run_n)
+    # seeds 0,1: test_a before test_b (passes); seeds 2,6: test_b first
+    # (fails) — precomputed permutations of random.Random(seed).shuffle
+    # over 4 items, injected via the SHOWFLAKES_SEED testing hook.
+    for run_n, seed in enumerate([0, 1, 2, 6]):
+        run_mode("shuffle", run_n, seed)
+    run_mode("testinspect", 0)
+
+    tests = write_tests(
+        data_dir=str(data), out_file=str(tmp_path / "tests.json"),
+        subjects_dir=str(subjects),
+        n_runs={"baseline": 4, "shuffle": 4, "testinspect": 1},
+    )
+    rows = tests["proj"]
+    labels = {nid.split("::")[-1]: row[1] for nid, row in rows.items()}
+    assert labels["test_stable"] == NON_FLAKY
+    assert labels["test_a"] == NON_FLAKY
+    assert labels["test_b"] == OD_FLAKY
+    assert labels["test_nod"] == FLAKY
+    for row in rows.values():
+        assert len(row) == 2 + 16          # req_runs, label, 16 features
+        assert row[2] > 0                  # covered lines
+        assert row[3] > 0                  # covered changes (churn joined)
+        assert row[5] > 0                  # execution time
+
+
+def test_static_features_on_richer_function(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent("""
+        import os
+        import json
+
+        def test_branchy():
+            vals = [v for v in range(10) if v % 2]
+            if os.sep and vals:
+                assert json.dumps(vals)
+            assert len(vals) == 5
+    """))
+    feats = ModuleAnalyzer().features_for(str(p), "test_branchy", 4)
+    depth, asserts, ext, volume, cc, loc, mi = feats
+    assert asserts == 2.0
+    assert ext == 2.0              # os, json
+    assert cc >= 4.0               # if + boolop + comprehension + filters
+    assert volume > 0 and loc >= 5 and 0 <= mi <= 100
+
+
+def test_numbits_roundtrip():
+    for lines in (set(), {0}, {1, 7, 8, 9, 200}, set(range(0, 977, 13))):
+        assert numbits_to_lines(lines_to_numbits(lines)) == lines
+
+
+def test_git_churn_counts_line_changes(tmp_path):
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True,
+                       env={**os.environ,
+                            "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                            "GIT_COMMITTER_NAME": "t",
+                            "GIT_COMMITTER_EMAIL": "t@t"})
+
+    git("init", "-q")
+    f = tmp_path / "a.py"
+    f.write_text("one\ntwo\nthree\n")
+    git("add", "a.py")
+    git("commit", "-qm", "c1")
+
+    f.write_text("one\nTWO!\nthree\n")        # modify line 2
+    git("commit", "-aqm", "c2")
+
+    f.write_text("zero\none\nTWO!\nthree\n")  # insert line 1 (shifts rest)
+    git("commit", "-aqm", "c3")
+
+    g = tmp_path / "café dir" / "naïve.py"     # C-quoted by git log
+    g.parent.mkdir()
+    g.write_text("x\n")
+    git("add", "-A")
+    git("commit", "-qm", "c4")
+
+    churn = git_churn(str(tmp_path))
+    assert churn["a.py"][1] == 1   # "zero": introduced once
+    assert churn["a.py"][2] == 1   # "one": introduced in c1, shifted only
+    assert churn["a.py"][3] == 2   # "TWO!": introduced + modified
+    assert churn["a.py"][4] == 1   # "three"
+    assert churn["café dir/naïve.py"] == {1: 1}
+
+    assert git_churn("/") is None  # not a git repo
