@@ -1,0 +1,57 @@
+"""On-demand build + load of the native collation fast path.
+
+``load()`` compiles ``collate_fast.cc`` into ``_collate_fast.so`` next to the
+source on first use (g++, CPython C API — no pybind11 in this image) and
+imports it; it returns None when no toolchain is available or the build
+fails, in which case runner/collate.py keeps its pure-Python implementations.
+The build is atomic (unique temp + rename) so concurrent processes race
+safely, and the .so is rebuilt whenever the source is newer.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "collate_fast.cc")
+_SO = os.path.join(_DIR, "_collate_fast.so")
+
+_cached = False
+_module = None
+
+
+def _build():
+    include = sysconfig.get_paths()["include"]
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-fPIC", "-shared", f"-I{include}", _SRC,
+             "-o", tmp],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, _SO)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def load():
+    """The native module, or None (cached after the first attempt)."""
+    global _cached, _module
+    if _cached:
+        return _module
+    _cached = True
+    try:
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            _build()
+        spec = importlib.util.spec_from_file_location(
+            "flake16_framework_tpu.native._collate_fast", _SO
+        )
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        _module = module
+    except Exception:
+        _module = None
+    return _module
