@@ -1,0 +1,44 @@
+"""Driver-contract tests for __graft_entry__.
+
+The driver invokes ``dryrun_multichip(8)`` via ``python -c`` in a fresh
+process with NO pytest environment (MULTICHIP_r01 failed precisely because the
+entry point relied on the conftest's virtual-device env vars). So this test
+runs it the driver's way: a clean subprocess with the conftest's JAX env
+scrubbed, on a 1-device host, and expects the entry point to self-provision
+its virtual mesh.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_driver_style():
+    env = dict(os.environ)
+    # Scrub everything the pytest conftest (or a previous child) injected so
+    # the subprocess sees what the driver's process sees.
+    env.pop("_FLAKE16_DRYRUN_VIRTUAL", None)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    if flags:
+        env["XLA_FLAGS"] = " ".join(flags)
+    else:
+        env.pop("XLA_FLAGS", None)
+    # Keep the parent off any real accelerator: the point is the re-exec
+    # path, which must fire whenever the parent has < 8 devices.
+    env["JAX_PLATFORMS"] = "cpu"
+
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert r.returncode == 0, f"stdout={r.stdout[-800:]}\nstderr={r.stderr[-800:]}"
+    assert "dryrun_multichip OK (stratified): 8 devices" in r.stdout
+    assert "dryrun_multichip OK (lopo): 8 devices" in r.stdout
