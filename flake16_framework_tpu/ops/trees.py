@@ -188,6 +188,35 @@ def _window_update(arr, start, updates, mask):
     return lax.dynamic_update_slice_in_dim(arr, merged, start, axis=0)
 
 
+def _emit_children(can_split, lw_b, lwy_b, tot_w_b, tot_wy_b):
+    """Cover values for the 2k children created by a batch of splits.
+
+    Child slot s in [0, 2k): rank r = s//2; the monotone split-rank is
+    inverted with searchsorted to find the r-th splitting parent slot.
+    Returns (child_vals [2W, 2], child_ok [2W], j_safe [2W] — each child's
+    parent slot, for extra per-child metadata like depth). Shared by both
+    growers so the BFS id-allocation invariants have one source of truth.
+    """
+    w_cap = can_split.shape[0]
+    child_slots = jnp.arange(2 * w_cap, dtype=jnp.int32)
+    r_of_slot = child_slots // 2
+    csum = jnp.cumsum(can_split.astype(jnp.int32))
+    j_of_slot = jnp.searchsorted(
+        csum, r_of_slot + 1, side="left"
+    ).astype(jnp.int32)
+    j_safe = jnp.minimum(j_of_slot, w_cap - 1)
+    is_right = (child_slots % 2) == 1
+    lw_s = lw_b[j_safe]
+    lwy_s = lwy_b[j_safe]
+    tw_s = tot_w_b[j_safe]
+    twy_s = tot_wy_b[j_safe]
+    cw_s = jnp.where(is_right, tw_s - lw_s, lw_s)
+    cwy_s = jnp.where(is_right, twy_s - lwy_s, lwy_s)
+    child_ok = child_slots < 2 * csum[-1]
+    child_vals = jnp.stack([cw_s - cwy_s, cwy_s], axis=-1)
+    return child_vals, child_ok, j_safe
+
+
 def _fit_one_tree(x, y01, w, key, order0, xsorted, *, random_splits,
                   max_features, max_depth, max_nodes):
     """Grow one tree level-by-level (see module docstring). Node arrays are
@@ -334,24 +363,9 @@ def _fit_one_tree(x, y01, w, key, order0, xsorted, *, random_splits,
         )
 
         # ---- child cover values, written at creation ----------------------
-        # Child slot s in [0, 2k): rank r = s//2; invert monotone ``rank``
-        # with searchsorted to find the r-th splitting frontier slot.
-        child_slots = jnp.arange(2 * w_cap, dtype=jnp.int32)
-        r_of_slot = child_slots // 2
-        csum = jnp.cumsum(can_split.astype(jnp.int32))
-        j_of_slot = jnp.searchsorted(
-            csum, r_of_slot + 1, side="left"
-        ).astype(jnp.int32)
-        j_safe = jnp.minimum(j_of_slot, w_cap - 1)
-        is_right = (child_slots % 2) == 1
-        lw_s = lw_b[j_safe]
-        lwy_s = lwy_b[j_safe]
-        tw_s = tot_w_b[j_safe]
-        twy_s = tot_wy_b[j_safe]
-        cw_s = jnp.where(is_right, tw_s - lw_s, lw_s)
-        cwy_s = jnp.where(is_right, twy_s - lwy_s, lwy_s)
-        child_ok = child_slots < 2 * k_splits
-        child_vals = jnp.stack([cw_s - cwy_s, cwy_s], axis=-1)
+        child_vals, child_ok, _ = _emit_children(
+            can_split, lw_b, lwy_b, tot_w_b, tot_wy_b
+        )
         value = _window_update(value, n_nodes, child_vals, child_ok[:, None])
 
         # ---- route samples to children / park finished nodes --------------
@@ -380,6 +394,277 @@ def _fit_one_tree(x, y01, w, key, order0, xsorted, *, random_splits,
 
     return (feature[:max_nodes], threshold[:max_nodes], left[:max_nodes],
             right[:max_nodes], value[:max_nodes], n_nodes)
+
+
+# --------------------------------------------------------------------------
+# Histogram grower — the MXU formulation.
+#
+# The exact grower above is sort/gather-bound: profiling on TPU v5e shows
+# >80% of fit time in `searchsorted` lowerings and `take_along_axis` gathers,
+# which TPUs execute serially (~14 ms per [60,16,1000] gather). The ensemble
+# path therefore uses the classic histogram formulation (LightGBM-style),
+# mapped to the MXU: features are quantile-binned ONCE, per-node class
+# histograms are computed as one-hot matmuls
+#     H[f, node, bin] = sum_n onehot_node[n, node] * w[n] * onehot_bin[n, f, b]
+# (a [W, N] x [N, F*B] contraction — pure MXU work), and split scores come
+# from cumulative sums over the bin axis. No sort, searchsorted, or gather
+# appears in the level loop; the only per-sample "lookup" (routing each
+# sample by its node's chosen feature) is itself a one-hot matmul.
+#
+# Growth is node-batched rather than level-synchronous: BFS allocation makes
+# node ids contiguous in creation order, so the work queue is just a pointer
+# pair (P = next unprocessed id, A = next free id) and each iteration
+# processes the id window [P, P+W). Iteration count is ceil(total_nodes / W)
+# — proportional to tree size, not depth x frontier like the exact grower.
+#
+# Binned thresholds are bin edges (quantile midpoints), not exact sklearn
+# midpoints, so this grower serves the 100-tree ensembles (RF/ET), where
+# split discretization washes out in the ensemble average (parity budget
+# BASELINE.md: F1 +/- 0.01); the single DecisionTree config keeps the exact
+# grower. ExtraTrees randomness: sklearn draws thresholds uniformly over the
+# node's value range; here the draw is uniform over the node's occupied bin
+# boundaries (rank-space rather than value-space uniform) — covered by the
+# same ensemble parity budget.
+# --------------------------------------------------------------------------
+
+HIST_BINS = 64
+HIST_NODE_BATCH = 128
+
+
+def quantile_edges(x, n_bins=HIST_BINS):
+    """Per-feature inner bin edges [F, n_bins-1]: midpoints between adjacent
+    sorted values at quantile ranks (the histogram analog of sklearn's
+    midpoint thresholds). Bin b covers edges[b-1] < x <= edges[b]."""
+    n, _ = x.shape
+    xs = jnp.sort(x, axis=0)
+    ks = jnp.clip((jnp.arange(1, n_bins) * n) // n_bins - 1, 0, n - 1)
+    lo = xs[ks]
+    hi = xs[jnp.clip(ks + 1, 0, n - 1)]
+    return ((lo + hi) * 0.5).T
+
+
+def _bin_onehot(x, edges):
+    """(onehot [N, F, B] bf16, bin_idx [N, F] i32) for inner ``edges``
+    [F, B-1]; bin index is the count of edges strictly below x."""
+    cmp = x[:, :, None] > edges[None, :, :]
+    bin_idx = cmp.sum(-1).astype(jnp.int32)
+    n_bins = edges.shape[1] + 1
+    oh = jax.nn.one_hot(bin_idx, n_bins, dtype=jnp.bfloat16)
+    return oh, bin_idx
+
+
+def _fit_one_tree_hist(ohfb, bin_idx, edges, y01, w, key, *, random_splits,
+                       max_features, max_depth, max_nodes):
+    """Grow one tree from binned features. Returns Forest field arrays
+    (same contract as ``_fit_one_tree``)."""
+    n, n_feat, n_bins = ohfb.shape
+    dt = edges.dtype
+    wdt = jnp.bfloat16  # one-hot/table matmul operands: small integers, exact
+    bw = min(HIST_NODE_BATCH, max_nodes)       # node-batch width
+    m_pad = max_nodes + 2 * bw
+    iota_w = jnp.arange(bw, dtype=jnp.int32)
+
+    feature = jnp.full((m_pad,), -1, jnp.int32)
+    threshold = jnp.zeros((m_pad,), dt)
+    left = jnp.full((m_pad,), -1, jnp.int32)
+    right = jnp.full((m_pad,), -1, jnp.int32)
+    value = jnp.zeros((m_pad, 2), dt)
+    depth = jnp.zeros((m_pad,), jnp.int32)
+
+    wy = w * y01
+    sample_node = jnp.where(w > 0, 0, -1).astype(jnp.int32)
+    tot_w0, tot_wy0 = jnp.sum(w), jnp.sum(wy)
+    value = value.at[0].set(jnp.stack([tot_w0 - tot_wy0, tot_wy0]))
+
+    def step(state):
+        (feature, threshold, left, right, value, depth, a, p,
+         sample_node) = state
+        kf, kt = jax.random.split(jax.random.fold_in(key, p))
+
+        # ---- node membership one-hot + class histograms (MXU) -------------
+        rel = sample_node - p                          # [N]
+        inb = (rel >= 0) & (rel < bw)
+        onehot = ((rel[:, None] == iota_w[None, :]) & inb[:, None])
+        ohw = (onehot * w[:, None]).astype(wdt)        # [N, W]
+        ohwy = (onehot * wy[:, None]).astype(wdt)
+        hw = jnp.einsum("nw,nfb->fwb", ohw, ohfb,
+                        preferred_element_type=jnp.float32)
+        hwy = jnp.einsum("nw,nfb->fwb", ohwy, ohfb,
+                         preferred_element_type=jnp.float32)
+
+        cw = jnp.cumsum(hw, axis=-1)                   # [F, W, B]
+        cwy = jnp.cumsum(hwy, axis=-1)
+        tot_w = cw[0, :, -1]                           # [W] (same for all f)
+        tot_wy = cwy[0, :, -1]
+        lw = cw[..., :-1]                              # boundary b -> [.., b-1]
+        lwy = cwy[..., :-1]
+        rw = tot_w[None, :, None] - lw
+        rwy = tot_wy[None, :, None] - lwy
+        valid = (lw > 0) & (rw > 0)                    # [F, W, B-1]
+        nc = jnp.any(valid, axis=-1)                   # [F, W] non-constant
+
+        if random_splits:
+            # ExtraTrees: boundary drawn uniformly over the node's occupied
+            # range [lo+1, hi] (lo/hi = first/last nonzero bin).
+            occ = hw > 0
+            lo = jnp.argmax(occ, axis=-1)              # [F, W]
+            hi = n_bins - 1 - jnp.argmax(jnp.flip(occ, -1), axis=-1)
+            span = jnp.maximum(hi - lo, 1)
+            u = jax.random.uniform(kt, (n_feat, bw), dtype=dt)
+            bsel = lo + 1 + jnp.floor(u * span).astype(jnp.int32)
+            ohb = jax.nn.one_hot(bsel - 1, n_bins - 1, dtype=jnp.float32)
+            lw_j = jnp.sum(lw * ohb, -1)
+            lwy_j = jnp.sum(lwy * ohb, -1)
+            ok_j = nc & (lw_j > 0) & (tot_w[None, :] - lw_j > 0)
+            score_j = _proxy_score(lw_j, lwy_j, tot_w[None, :] - lw_j,
+                                   tot_wy[None, :] - lwy_j, ok_j)
+            bound_j = bsel
+        else:
+            score = _proxy_score(lw, lwy, rw, rwy, valid)   # [F, W, B-1]
+            bb = jnp.argmax(score, axis=-1)            # first max = lowest thr
+            score_j = jnp.max(score, axis=-1)
+            bound_j = bb + 1
+            ohb = jax.nn.one_hot(bb, n_bins - 1, dtype=jnp.float32)
+            lw_j = jnp.sum(lw * ohb, -1)
+            lwy_j = jnp.sum(lwy * ohb, -1)
+        thr_j = jnp.sum(edges[:, None, :] * ohb, -1)   # [F, W]
+
+        # ---- feature choice (sklearn random feature draw) -----------------
+        sel = _select_features(nc.transpose(1, 0), kf, max_features)
+        score_j = jnp.where(sel.transpose(1, 0), score_j, -jnp.inf)
+        best_f = jnp.argmax(score_j, axis=0).astype(jnp.int32)     # [W]
+        best_score = jnp.max(score_j, axis=0)
+        ohf = jax.nn.one_hot(best_f, n_feat, dtype=jnp.float32)    # [W, F]
+
+        def pick_f(a):                                  # [F, W] -> [W]
+            return jnp.sum(a.transpose(1, 0) * ohf, -1)
+
+        thr_node = pick_f(thr_j).astype(dt)
+        bound_n = jnp.round(pick_f(bound_j.astype(jnp.float32)))
+        lw_b = pick_f(lw_j)
+        lwy_b = pick_f(lwy_j)
+
+        # ---- split decision ----------------------------------------------
+        present = iota_w < (a - p)
+        dep = lax.dynamic_slice_in_dim(depth, p, bw)
+        impure = (tot_wy > 0) & (tot_w - tot_wy > 0)
+        can_split = (
+            (best_score > -jnp.inf) & impure & present & (dep < max_depth)
+        )
+        rank = _exclusive_cumsum(can_split.astype(jnp.int32))
+        left_g = a + 2 * rank
+        right_g = left_g + 1
+        can_split = can_split & (right_g < max_nodes)
+        k_splits = jnp.sum(can_split, dtype=jnp.int32)
+
+        feature = _window_update(
+            feature, p, jnp.where(can_split, best_f, -1), can_split
+        )
+        threshold = _window_update(threshold, p, thr_node, can_split)
+        left = _window_update(
+            left, p, jnp.where(can_split, left_g, -1), can_split
+        )
+        right = _window_update(
+            right, p, jnp.where(can_split, right_g, -1), can_split
+        )
+
+        # ---- child covers + depth, written at creation --------------------
+        child_vals, child_ok, j_safe = _emit_children(
+            can_split, lw_b, lwy_b, tot_w, tot_wy
+        )
+        value = _window_update(value, a, child_vals, child_ok[:, None])
+        depth = _window_update(depth, a, dep[j_safe] + 1, child_ok)
+
+        # ---- route samples via one per-node table matmul ------------------
+        # table rows: [can_split, rank, bound] ++ onehot(best_f) — all small
+        # integers, exact in bf16 with f32 accumulation.
+        table = jnp.concatenate(
+            [can_split.astype(jnp.float32)[:, None],
+             rank.astype(jnp.float32)[:, None],
+             bound_n[:, None], ohf], axis=1,
+        ).astype(wdt)
+        route = jnp.einsum("nw,wc->nc", onehot.astype(wdt), table,
+                           preferred_element_type=jnp.float32)
+        can_mine = route[:, 0] > 0.5
+        rank_mine = jnp.round(route[:, 1]).astype(jnp.int32)
+        bound_mine = route[:, 2]
+        xbin_mine = jnp.sum(bin_idx.astype(jnp.float32) * route[:, 3:], -1)
+        go_left = xbin_mine < bound_mine
+        child_mine = a + 2 * rank_mine + jnp.where(go_left, 0, 1)
+        sample_node = jnp.where(
+            inb & can_mine, child_mine, jnp.where(inb, -1, sample_node)
+        ).astype(jnp.int32)
+
+        return (feature, threshold, left, right, value, depth,
+                a + 2 * k_splits, jnp.minimum(p + bw, a), sample_node)
+
+    def cond(state):
+        a, p = state[6], state[7]
+        return p < a
+
+    state = (feature, threshold, left, right, value, depth, jnp.int32(1),
+             jnp.int32(0), sample_node)
+    state = lax.while_loop(cond, step, state)
+    feature, threshold, left, right, value = state[:5]
+    n_nodes = state[6]
+    return (feature[:max_nodes], threshold[:max_nodes], left[:max_nodes],
+            right[:max_nodes], value[:max_nodes], n_nodes)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_trees", "bootstrap", "random_splits", "sqrt_features", "max_depth",
+        "max_nodes", "tree_chunk", "n_bins",
+    ),
+)
+def fit_forest_hist(x, y, w, key, *, n_trees, bootstrap, random_splits,
+                    sqrt_features, max_depth=48, max_nodes=None,
+                    tree_chunk=None, n_bins=HIST_BINS, edges=None):
+    """Histogram-grower twin of ``fit_forest`` (same signature + ``n_bins``/
+    ``edges``). ``edges`` [F, n_bins-1] may be precomputed (e.g. once per
+    config from the full preprocessed matrix, shared across folds); derived
+    from ``x`` when None. Returns the same ``Forest`` structure, so predict
+    and Tree SHAP are grower-agnostic."""
+    n, f = x.shape
+    if max_nodes is None:
+        max_nodes = 2 * n
+    max_features = max(1, int(f ** 0.5)) if sqrt_features else None
+
+    y01 = y.astype(x.dtype)
+    w = w.astype(x.dtype)
+    if edges is None:
+        edges = quantile_edges(x, n_bins)
+    ohfb, bin_idx = _bin_onehot(x, edges)
+
+    keys = jax.random.split(key, n_trees)
+
+    def one(k):
+        kb, kg = jax.random.split(k)
+        wt = _bootstrap_weights(w, kb) if bootstrap else w
+        return _fit_one_tree_hist(
+            ohfb, bin_idx, edges, y01, wt, kg, random_splits=random_splits,
+            max_features=max_features, max_depth=max_depth,
+            max_nodes=max_nodes,
+        )
+
+    feature, threshold, left, right, value, n_nodes = _map_trees(
+        one, keys, n_trees, tree_chunk
+    )
+    return Forest(feature, threshold, left, right, value, n_nodes,
+                  jnp.int32(max_depth))
+
+
+def _map_trees(one, keys, n_trees, tree_chunk):
+    """vmap ``one`` over per-tree keys, optionally in sequential chunks of
+    ``tree_chunk`` via ``lax.map`` (bounds the concurrent per-tree workspace;
+    results are identical since keys don't depend on chunking)."""
+    if tree_chunk is None or tree_chunk >= n_trees:
+        return jax.vmap(one)(keys)
+    pad = (-n_trees) % tree_chunk
+    keys_p = jnp.concatenate([keys, keys[:pad]]).reshape(-1, tree_chunk, 2)
+    out = lax.map(jax.vmap(one), keys_p)
+    return jax.tree.map(lambda a: a.reshape(-1, *a.shape[2:])[:n_trees], out)
 
 
 def _bootstrap_weights(w, key):
@@ -445,17 +730,9 @@ def fit_forest(x, y, w, key, *, n_trees, bootstrap, random_splits,
             max_features=max_features, max_depth=max_depth, max_nodes=max_nodes,
         )
 
-    if tree_chunk is None or tree_chunk >= n_trees:
-        feature, threshold, left, right, value, n_nodes = jax.vmap(one)(keys)
-    else:
-        pad = (-n_trees) % tree_chunk
-        keys_p = jnp.concatenate([keys, keys[:pad]]).reshape(
-            -1, tree_chunk, 2
-        )
-        out = lax.map(jax.vmap(one), keys_p)
-        feature, threshold, left, right, value, n_nodes = jax.tree.map(
-            lambda a: a.reshape(-1, *a.shape[2:])[:n_trees], out
-        )
+    feature, threshold, left, right, value, n_nodes = _map_trees(
+        one, keys, n_trees, tree_chunk
+    )
     return Forest(feature, threshold, left, right, value, n_nodes,
                   jnp.int32(max_depth))
 

@@ -38,11 +38,17 @@ from flake16_framework_tpu.parallel.folds import fold_masks, lopo_fold_masks
 N_FOLDS = 10
 
 
-def _auto_tree_chunk(spec, n_folds, tree_chunk, budget=64):
-    """Bound concurrent tree fits at ~``budget`` across the fold x tree grid
-    (fit_forest docstring: unchunked 100x10 overruns TPU memory)."""
+def _auto_tree_chunk(spec, n_folds, tree_chunk, use_hist):
+    """Bound concurrent tree fits across the fold x tree grid (fit_forest
+    docstring: the per-level workspace is per-tree-in-flight). The hist
+    grower's workspace is ~20x smaller than the exact grower's
+    ([N, node_batch] one-hots vs [F, N] sort/gather buffers), so its budget
+    is correspondingly larger. ``use_hist`` must be the same predicate that
+    selects the grower in ``_make_config_fns`` or the budget would be sized
+    for the wrong workspace."""
     if tree_chunk is not None:
         return tree_chunk
+    budget = 320 if use_hist else 64
     if spec.n_trees * n_folds <= budget:
         return None
     return max(1, budget // n_folds)
@@ -63,17 +69,33 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
     if cap is None:
         cap = 2 * n  # SMOTE at worst doubles the training set
     max_nodes = 2 * cap
-    tree_chunk = _auto_tree_chunk(spec, n_folds, tree_chunk)
+    # Ensembles fit via the MXU histogram grower (trees.py: binned splits
+    # wash out in the 100-tree average); the single DecisionTree keeps the
+    # exact sort-based grower for sklearn-exact splits.
+    use_hist = spec.n_trees > 1
+    tree_chunk = _auto_tree_chunk(spec, n_folds, tree_chunk, use_hist)
 
     def fit_one(x, y_raw, flaky_label, prep_code, bal_code, key, train_mask):
         y = y_raw == flaky_label
         mu, wmat = fit_preprocess(x, prep_code)
         xp = transform(x, mu, wmat)
         fold_keys = jax.random.split(key, n_folds)
+        # Bin edges once per config from the full preprocessed matrix
+        # (fold-independent by construction; the reference already fits
+        # preprocessing on the full matrix, experiment.py:452-453).
+        edges = trees.quantile_edges(xp) if use_hist else None
 
         def fold(fold_key, w_train):
             kb, kf = jax.random.split(fold_key)
             xs, ys, ws = resample(xp, y, w_train, bal_code, kb, cap)
+            if use_hist:
+                return trees.fit_forest_hist(
+                    xs, ys, ws, kf, n_trees=spec.n_trees,
+                    bootstrap=spec.bootstrap,
+                    random_splits=spec.random_splits,
+                    sqrt_features=spec.sqrt_features, max_depth=max_depth,
+                    max_nodes=max_nodes, tree_chunk=tree_chunk, edges=edges,
+                )
             return trees.fit_forest(
                 xs, ys, ws, kf, n_trees=spec.n_trees,
                 bootstrap=spec.bootstrap, random_splits=spec.random_splits,

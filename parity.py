@@ -1,0 +1,222 @@
+"""Per-config F1 parity harness: our TPU sweep vs the pinned-stack pipeline.
+
+BASELINE.md:28 requires per-config F1 within +/-0.01 of the sklearn stack on
+the BASELINE.json probe configs. Both stacks carry irreducible RNG (sklearn
+trees tie-break via MT19937 draws we cannot replicate; our PRNG is jax's), so
+the comparison is between *seed-averaged* means — explicitly allowed by the
+criterion ("ensemble configs may average seeds") — with the sample noise
+reported alongside: for each config we print ours mean+/-sd (K_ours seeds),
+sklearn mean+/-sd (K_sk seeds), the mean difference, and the standard error
+of that difference. The +/-0.01 assertion is made at a size where SE < 0.01
+(``--full``: N=4000+, 100 trees — run on the TPU); the small tier (pytest,
+CPU) uses the same machinery as a regression guard with a tolerance scaled
+to its own measured noise.
+
+Reference semantics replicated on the sklearn side (experiment.py:446-490):
+full-data preprocessing before CV, StratifiedKFold(10, shuffle, rs=0),
+balance train folds only, pooled confusion -> P/R/F1. The resamplers use the
+same numpy oracles as tests/ref_resamplers.py (imblearn 0.9 semantics;
+imbalanced-learn is not installed here).
+
+Usage:
+    python parity.py            # small tier (CPU-friendly)
+    python parity.py --full     # assertion tier (TPU; writes PARITY.json)
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+# The three `scores` probe configs from BASELINE.json (the other two probes
+# are the SHAP configs and the full-sweep run, covered elsewhere).
+PROBE_CONFIGS = [
+    ("NOD", "Flake16", "None", "None", "Decision Tree"),
+    ("NOD", "Flake16", "Scaling", "SMOTE", "Random Forest"),
+    ("OD", "Flake16", "PCA", "SMOTE Tomek", "Extra Trees"),
+]
+
+
+def _f1_from_conf(fp, fn, tp):
+    prec = tp / (tp + fp) if tp + fp else None
+    rec = tp / (tp + fn) if tp + fn else None
+    if not prec or not rec:
+        return 0.0
+    return 2 * prec * rec / (prec + rec)
+
+
+def _smote_np(x, y, rng):
+    """imblearn-0.9-semantics SMOTE (numpy oracle, same draw structure)."""
+    minority = 1 if (y == 1).sum() < (y == 0).sum() else 0
+    x_min = x[y == minority]
+    n_min, n_maj = len(x_min), int((y != minority).sum())
+    n_new = n_maj - n_min
+    if n_new > 0 and n_min > 1:
+        d = ((x_min[:, None] - x_min[None]) ** 2).sum(-1)
+        np.fill_diagonal(d, np.inf)
+        k = min(5, n_min - 1)
+        nn = np.argsort(d, axis=1)[:, :k]
+        pick = rng.randint(0, n_min * k, n_new)
+        base, col = pick // k, pick % k
+        steps = rng.uniform(size=(n_new, 1))
+        x_new = x_min[base] + steps * (x_min[nn[base, col]] - x_min[base])
+        x = np.vstack([x, x_new])
+        y = np.concatenate([y, np.full(n_new, bool(minority))])
+    return x, y
+
+
+def sklearn_config_f1(feats, labels, keys, *, n_trees, seed):
+    """One seed of the reference pipeline for one config."""
+    from sklearn.tree import DecisionTreeClassifier
+    from sklearn.ensemble import (RandomForestClassifier,
+                                  ExtraTreesClassifier)
+    from sklearn.preprocessing import StandardScaler
+    from sklearn.decomposition import PCA
+    from sklearn.pipeline import Pipeline
+    from sklearn.model_selection import StratifiedKFold
+    from ref_resamplers import tomek_keep_ref, enn_keep_ref
+
+    from flake16_framework_tpu import config as cfg
+
+    fl_name, fs_name, prep_name, bal_name, model_name = keys
+    fl = cfg.FLAKY_TYPES[fl_name]
+    cols = list(cfg.FEATURE_SETS[fs_name])
+    x = feats[:, cols].astype(np.float64)
+    y = labels == fl
+    if prep_name == "Scaling":
+        x = StandardScaler().fit_transform(x)
+    elif prep_name == "PCA":
+        x = Pipeline([("s", StandardScaler()),
+                      ("p", PCA(random_state=0))]).fit_transform(x)
+
+    models = {
+        "Decision Tree": lambda: DecisionTreeClassifier(random_state=seed),
+        "Random Forest": lambda: RandomForestClassifier(
+            random_state=seed, n_estimators=n_trees),
+        "Extra Trees": lambda: ExtraTreesClassifier(
+            random_state=seed, n_estimators=n_trees),
+    }
+    rng = np.random.RandomState(seed)
+
+    def balance(xb, yb):
+        if bal_name == "None":
+            return xb, yb
+        if bal_name == "Tomek Links":
+            keep = tomek_keep_ref(xb, yb, False)
+            return xb[keep], yb[keep]
+        if bal_name == "ENN":
+            keep = enn_keep_ref(xb, yb, False)
+            return xb[keep], yb[keep]
+        xb, yb = _smote_np(xb, yb, rng)
+        if bal_name == "SMOTE Tomek":
+            keep = tomek_keep_ref(xb, yb, True)
+            return xb[keep], yb[keep]
+        if bal_name == "SMOTE ENN":
+            keep = enn_keep_ref(xb, yb, True)
+            return xb[keep], yb[keep]
+        return xb, yb
+
+    fp = fn = tp = 0
+    skf = StratifiedKFold(n_splits=10, shuffle=True, random_state=0)
+    for tr, te in skf.split(x, y):
+        xb, yb = balance(x[tr], y[tr])
+        m = models[model_name]().fit(xb, yb)
+        p = m.predict(x[te])
+        fp += int((~y[te] & p).sum())
+        fn += int((y[te] & ~p).sum())
+        tp += int((y[te] & p).sum())
+    return _f1_from_conf(fp, fn, tp)
+
+
+def ours_config_f1s(feats, labels, pids, keys, *, n_trees, seeds):
+    """Our jitted sweep for one config across seeds. One engine serves all
+    seeds: the PRNG key is a traced argument of the compiled CV program
+    (sweep.py run_config), so varying ``engine.seed`` hits the jit cache."""
+    from flake16_framework_tpu.parallel.sweep import SweepEngine
+
+    names = [f"project{p:02d}" for p in range(int(pids.max()) + 1)]
+    projects = np.array([names[p] for p in pids])
+    engine = SweepEngine(
+        feats, labels, projects, names, pids,
+        tree_overrides={"Random Forest": n_trees, "Extra Trees": n_trees},
+    )
+    out = []
+    for s in seeds:
+        engine.seed = s
+        out.append(_f1_from_conf(*engine.run_config(keys)[3][:3]))
+    return out
+
+
+def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
+               nod_bump=2.5, od_bump=1.8, noise_sigma=0.35, configs=None):
+    """Seed-averaged F1 comparison. Returns a report dict per config."""
+    from flake16_framework_tpu.utils.synth import make_dataset
+
+    feats, labels, pids = make_dataset(
+        n_tests=n_tests, seed=data_seed, nod_bump=nod_bump, od_bump=od_bump,
+        noise_sigma=noise_sigma,
+    )
+    report = {}
+    for keys in (configs or PROBE_CONFIGS):
+        deterministic = keys[4] == "Decision Tree" and "SMOTE" not in keys[3]
+        ko = 1 if deterministic else k_ours
+        ours = ours_config_f1s(feats, labels, pids, keys,
+                               n_trees=n_trees, seeds=range(ko))
+        sk = [sklearn_config_f1(feats, labels, keys,
+                                n_trees=n_trees, seed=s)
+              for s in range(k_sk)]
+        o, s = np.array(ours), np.array(sk)
+        se = float(np.sqrt(
+            (o.std(ddof=1) ** 2 / len(o) if len(o) > 1 else 0.0)
+            + s.std(ddof=1) ** 2 / len(s)
+        ))
+        report["/".join(keys)] = {
+            "ours_mean": round(float(o.mean()), 4),
+            "ours_sd": round(float(o.std()), 4),
+            "ours_k": len(o),
+            "sklearn_mean": round(float(s.mean()), 4),
+            "sklearn_sd": round(float(s.std()), 4),
+            "sklearn_k": len(s),
+            "delta": round(float(o.mean() - s.mean()), 4),
+            "se_delta": round(se, 4),
+        }
+        print(json.dumps({keys[4]: report["/".join(keys)]}), flush=True)
+    return report
+
+
+def main():
+    full = "--full" in sys.argv
+    if full:
+        rep = run_parity(n_tests=4000, n_trees=100, k_ours=6, k_sk=6)
+        tol = 0.01
+        out = {"tier": "full", "n_tests": 4000, "n_trees": 100,
+               "tolerance": tol, "configs": rep,
+               "ok": all(abs(v["delta"]) <= tol for v in rep.values())}
+        with open(os.path.join(REPO, "PARITY.json"), "w") as fd:
+            json.dump(out, fd, indent=2)
+        print(json.dumps({"parity_ok": out["ok"], "tolerance": tol}))
+        if not out["ok"]:
+            sys.exit(1)
+    else:
+        run_small_tier()
+        print(json.dumps({"parity_small_ok": True}))
+
+
+def run_small_tier():
+    """The CPU regression tier (shared by ``python parity.py`` and pytest):
+    same machinery as --full, sized for CI, tolerance scaled to its own
+    measured noise (at this size sklearn's seed sd alone exceeds 0.01)."""
+    rep = run_parity(n_tests=800, n_trees=16, k_ours=2, k_sk=4)
+    for name, v in rep.items():
+        tol = max(0.05, 3 * v["se_delta"])
+        assert abs(v["delta"]) <= tol, (name, v)
+    return rep
+
+
+if __name__ == "__main__":
+    main()

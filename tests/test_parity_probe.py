@@ -1,0 +1,22 @@
+"""Per-config F1 parity regression guard (BASELINE.md:28, VERDICT item 3).
+
+Runs the parity harness's small tier: the three BASELINE.json `scores` probe
+configs end-to-end (preprocess -> resample -> fit -> predict -> score), our
+jitted sweep vs the sklearn stack with the numpy imblearn oracles, seed-
+averaged. At this size the sklearn baseline's own seed noise exceeds 0.01,
+so the small tier's tolerance is scaled to its measured standard error; the
+strict +/-0.01 assertion lives in `python parity.py --full` (TPU-sized runs,
+results recorded in PARITY.json / README).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import parity
+
+
+def test_probe_configs_f1_parity_small_tier():
+    report = parity.run_small_tier()
+    assert set(report) == {"/".join(k) for k in parity.PROBE_CONFIGS}

@@ -47,8 +47,11 @@ def test_dt_config_total_confusion_matches_sklearn(engine):
     ours = np.array(total[:3])
     theirs = np.array([fp, fn, tp])
     # Identical fold assignment (exact KFold replication); residual diffs are
-    # tree tie-break noise on a handful of samples.
-    assert np.abs(ours - theirs).sum() <= max(4, int(0.25 * theirs.sum()))
+    # tree tie-break noise on a handful of samples. Measured on this dataset:
+    # |diff| = 2 vs sklearn seed 0, and sklearn's own tie-break RNG moves its
+    # counts by up to 5 across random_state in 0..3 (FP 13..18), so a hard
+    # bound of 6 is one count above sklearn's own spread.
+    assert np.abs(ours - theirs).sum() <= 6
 
 
 def test_grid_subset_schema_and_ledger(engine):
