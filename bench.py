@@ -1,22 +1,26 @@
-"""Benchmark: TPU sweep vs single-host sklearn on the probe configs.
+"""Benchmark: TPU scores+shap pipeline vs the single-host CPU stack.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} (last line of
-stdout), whatever happens to the device.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", "detail"}
+(last line of stdout), whatever happens to the device. The headline value is
+the combined end-to-end speedup of the two north-star stages (BASELINE.md:
+"scores + shap wall-clock >= 20x"): the 6-config scores probe (all three
+model families) plus the 2 reference SHAP configs.
 
-Baseline (BASELINE.md): the reference publishes no numbers, so the baseline is
-self-measured — the same configs on the single-host CPU stack the reference
-uses (sklearn trees; the resampling steps use this repo's numpy oracles since
-imbalanced-learn is not installed here, matching imblearn 0.9 semantics).
-Ours: the jitted JAX sweep, steady-state (one compiled graph per model family
-serves all configs of that family across the full 216-config grid, so
-compile time is excluded).
+Baseline (self-measured; the reference publishes no numbers): the same
+configs on the single-host CPU stack the reference uses — sklearn trees +
+this repo's numpy oracles for imblearn 0.9 resampling (imbalanced-learn is
+not installed) and for shap 0.40's path-dependent Tree SHAP (tests/
+ref_treeshap.py, oracle-validated; shap is not installed). Ours: the jitted
+JAX sweep + the Pallas Tree SHAP kernel, steady-state (one compiled graph
+per model family serves all of that family's configs across the 216-config
+grid, so compile time is excluded; SHAP likewise warms once per config).
 
 Robustness: the accelerator runs in a SUBPROCESS. The TPU tunnel in this
-environment can fault or wedge on oversized dispatches (see
-ops/trees.py docstring); a crashed subprocess must not take the bench down,
-so the parent probes device health first, retries once, and falls back to
-measuring the same JAX pipeline on CPU (reported honestly via
-``detail.backend``) rather than emitting nothing.
+environment can fault or wedge (see ops/trees.py docstring); a crashed
+subprocess must not take the bench down, so the parent probes device health
+first, retries once, and falls back to the same full pipeline on the CPU
+backend at reduced size — all three model families kept, trees and N scaled
+down on BOTH sides (reported honestly via the metric name + detail).
 """
 
 import json
@@ -27,10 +31,19 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
 
 N_TESTS = int(os.environ.get("BENCH_N_TESTS", "2000"))
+N_TREES = int(os.environ.get("BENCH_N_TREES", "100"))
 SEED = 7
 WORKER_TIMEOUT_S = int(os.environ.get("BENCH_WORKER_TIMEOUT_S", "540"))
+# CPU-fallback sizing: every model family keeps an end-to-end number, with
+# N and ensemble size scaled to what the CPU backend can fit in the budget.
+FB_N_TESTS = int(os.environ.get("BENCH_FB_N_TESTS", "400"))
+FB_N_TREES = int(os.environ.get("BENCH_FB_N_TREES", "25"))
+# SHAP stage: explain the first SHAP_EXPLAIN samples on BOTH sides (the
+# full-N numpy baseline alone would take ~5 minutes at N=2000).
+SHAP_EXPLAIN = int(os.environ.get("BENCH_SHAP_EXPLAIN", "512"))
 
 # Probe configs (BASELINE.json "configs" №1-3 + family coverage).
 CONFIGS = [
@@ -43,115 +56,151 @@ CONFIGS = [
 ]
 
 
-def make_data():
-    from flake16_framework_tpu.utils.synth import make_dataset
-
-    feats, labels, pids = make_dataset(n_tests=N_TESTS, seed=SEED)
-    names = [f"project{p:02d}" for p in range(26)]
+def make_data(n_tests):
     import numpy as np
 
+    from flake16_framework_tpu.utils.synth import make_dataset
+
+    feats, labels, pids = make_dataset(n_tests=n_tests, seed=SEED)
+    names = [f"project{p:02d}" for p in range(26)]
     projects = np.array([names[p] for p in pids])
     return feats, labels, projects, names, pids
 
 
-def sklearn_baseline(feats, labels_raw, configs):
-    """Single-host CPU reference pipeline per config (reference get_scores
-    semantics: full-data preprocess, stratified 10-fold, balance train only,
-    fit, predict)."""
+def _np_balance(name, x, y, rng):
+    """imblearn-0.9-semantics resampling via the numpy oracles."""
     import numpy as np
+
+    from ref_resamplers import tomek_keep_ref, enn_keep_ref
+
+    if name == "None":
+        return x, y
+    if name == "Tomek Links":
+        keep = tomek_keep_ref(x, y, False)
+        return x[keep], y[keep]
+    if name == "ENN":
+        keep = enn_keep_ref(x, y, False)
+        return x[keep], y[keep]
+    # SMOTE-based
+    minority = 1 if (y == 1).sum() < (y == 0).sum() else 0
+    x_min = x[y == minority]
+    n_min, n_maj = len(x_min), int((y != minority).sum())
+    n_new = n_maj - n_min
+    if n_new > 0 and n_min > 1:
+        d = ((x_min[:, None] - x_min[None]) ** 2).sum(-1)
+        np.fill_diagonal(d, np.inf)
+        k = min(5, n_min - 1)
+        nn = np.argsort(d, axis=1)[:, :k]
+        pick = rng.randint(0, n_min * k, n_new)
+        base, col = pick // k, pick % k
+        steps = rng.uniform(size=(n_new, 1))
+        x_new = x_min[base] + steps * (x_min[nn[base, col]] - x_min[base])
+        x = np.vstack([x, x_new])
+        y = np.concatenate([y, np.full(n_new, bool(minority))])
+    if name == "SMOTE Tomek":
+        keep = tomek_keep_ref(x, y, True)
+        return x[keep], y[keep]
+    if name == "SMOTE ENN":
+        keep = enn_keep_ref(x, y, True)
+        return x[keep], y[keep]
+    return x, y
+
+
+def _sk_model(model_name, n_trees, seed=0):
     from sklearn.tree import DecisionTreeClassifier
     from sklearn.ensemble import RandomForestClassifier, ExtraTreesClassifier
+
+    if model_name == "Decision Tree":
+        return DecisionTreeClassifier(random_state=seed)
+    cls = {"Random Forest": RandomForestClassifier,
+           "Extra Trees": ExtraTreesClassifier}[model_name]
+    return cls(random_state=seed, n_estimators=n_trees)
+
+
+def _sk_prep(prep_name, x):
     from sklearn.preprocessing import StandardScaler
     from sklearn.decomposition import PCA
     from sklearn.pipeline import Pipeline
-    from sklearn.model_selection import StratifiedKFold
 
-    sys.path.insert(0, os.path.join(REPO, "tests"))
-    from ref_resamplers import tomek_keep_ref, enn_keep_ref
+    if prep_name == "Scaling":
+        return StandardScaler().fit_transform(x)
+    if prep_name == "PCA":
+        return Pipeline([("s", StandardScaler()),
+                         ("p", PCA(random_state=0))]).fit_transform(x)
+    return x
+
+
+def cpu_scores_baseline(feats, labels_raw, configs, n_trees):
+    """Single-host CPU reference per config (reference get_scores semantics:
+    full-data preprocess, stratified 10-fold, balance train only, fit,
+    predict). Returns per-config wall-clock seconds."""
+    import numpy as np
+    from sklearn.model_selection import StratifiedKFold
 
     from flake16_framework_tpu import config as cfg
 
     rng = np.random.RandomState(0)
-
-    def balance(name, x, y):
-        if name == "None":
-            return x, y
-        if name in ("Tomek Links",):
-            keep = tomek_keep_ref(x, y, False)
-            return x[keep], y[keep]
-        if name == "ENN":
-            keep = enn_keep_ref(x, y, False)
-            return x[keep], y[keep]
-        # SMOTE-based: numpy SMOTE (imblearn 0.9 semantics)
-        minority = 1 if (y == 1).sum() < (y == 0).sum() else 0
-        x_min = x[y == minority]
-        n_min, n_maj = len(x_min), (y != minority).sum()
-        n_new = int(n_maj - n_min)
-        if n_new > 0 and n_min > 1:
-            d = ((x_min[:, None] - x_min[None]) ** 2).sum(-1)
-            np.fill_diagonal(d, np.inf)
-            k = min(5, n_min - 1)
-            nn = np.argsort(d, axis=1)[:, :k]
-            pick = rng.randint(0, n_min * k, n_new)
-            base, col = pick // k, pick % k
-            steps = rng.uniform(size=(n_new, 1))
-            x_new = x_min[base] + steps * (x_min[nn[base, col]] - x_min[base])
-            x = np.vstack([x, x_new])
-            y = np.concatenate([y, np.full(n_new, bool(minority))])
-        if name == "SMOTE Tomek":
-            keep = tomek_keep_ref(x, y, True)
-            return x[keep], y[keep]
-        if name == "SMOTE ENN":
-            keep = enn_keep_ref(x, y, True)
-            return x[keep], y[keep]
-        return x, y
-
-    models = {
-        "Decision Tree": lambda: DecisionTreeClassifier(random_state=0),
-        "Random Forest": lambda: RandomForestClassifier(random_state=0),
-        "Extra Trees": lambda: ExtraTreesClassifier(random_state=0),
-    }
-    preps = {
-        "None": None,
-        "Scaling": lambda: StandardScaler(),
-        "PCA": lambda: Pipeline([("s", StandardScaler()),
-                                 ("p", PCA(random_state=0))]),
-    }
-
     times = []
     for keys in configs:
         t0 = time.time()
         fl_name, fs_name, prep_name, bal_name, model_name = keys
         fl = cfg.FLAKY_TYPES[fl_name]
         cols = list(cfg.FEATURE_SETS[fs_name])
-        x = feats[:, cols]
+        x = _sk_prep(prep_name, feats[:, cols])
         y = labels_raw == fl
-        if preps[prep_name] is not None:
-            x = preps[prep_name]().fit_transform(x)
         skf = StratifiedKFold(n_splits=10, shuffle=True, random_state=0)
         for tr, te in skf.split(x, y):
-            xb, yb = balance(bal_name, x[tr], y[tr])
-            m = models[model_name]().fit(xb, yb)
+            xb, yb = _np_balance(bal_name, x[tr], y[tr], rng)
+            m = _sk_model(model_name, n_trees).fit(xb, yb)
             m.predict(x[te])
         times.append(time.time() - t0)
     return times
 
 
-def worker(config_idx):
-    """Subprocess body: run the jitted sweep on the default backend for the
-    given CONFIGS subset and print one JSON line {"t_ours": seconds}."""
-    import jax  # noqa: F401  (device init happens here, inside the sandbox)
+def cpu_shap_baseline(feats, labels_raw, n_trees):
+    """Reference shap stage on CPU (experiment.py:504-530 semantics): per
+    SHAP config, preprocess full data, fit on the balanced full set, explain
+    every sample with path-dependent Tree SHAP (numpy oracle). Returns
+    per-config wall-clock seconds."""
+    import numpy as np
 
+    from ref_treeshap import forest_shap_class0_ref, sklearn_forest_trees
+    from flake16_framework_tpu import config as cfg
+
+    rng = np.random.RandomState(0)
+    times = []
+    for keys in cfg.SHAP_CONFIGS:
+        t0 = time.time()
+        fl_name, fs_name, prep_name, bal_name, model_name = keys
+        fl = cfg.FLAKY_TYPES[fl_name]
+        cols = list(cfg.FEATURE_SETS[fs_name])
+        x = _sk_prep(prep_name, feats[:, cols])
+        y = labels_raw == fl
+        xb, yb = _np_balance(bal_name, x, y, rng)
+        m = _sk_model(model_name, n_trees).fit(xb, yb)
+        forest_shap_class0_ref(sklearn_forest_trees(m),
+                               x[:min(SHAP_EXPLAIN, len(x))])
+        times.append(time.time() - t0)
+    return times
+
+
+def worker(n_tests, n_trees):
+    """Subprocess body: run the jitted scores probe + the 2 SHAP configs on
+    the default backend; print one JSON line with steady-state timings."""
+    import jax
+
+    from flake16_framework_tpu import config as cfg, pipeline
     from flake16_framework_tpu.parallel.sweep import SweepEngine
 
-    configs = [CONFIGS[i] for i in config_idx]
-    feats, labels, projects, names, pids = make_data()
-    engine = SweepEngine(feats, labels, projects, names, pids)
+    feats, labels, projects, names, pids = make_data(n_tests)
+    overrides = {"Random Forest": n_trees, "Extra Trees": n_trees}
+    engine = SweepEngine(feats, labels, projects, names, pids,
+                         tree_overrides=overrides)
 
     # Warm-up: compile each family graph once (steady-state measurement —
     # one compile serves all configs of a family across the full 216 grid).
     seen = set()
-    for keys in configs:
+    for keys in CONFIGS:
         fam = (keys[1], keys[4])
         if fam not in seen:
             engine.run_config(keys)
@@ -159,10 +208,32 @@ def worker(config_idx):
             print(f"warmed {fam}", file=sys.stderr, flush=True)
 
     t0 = time.time()
-    for keys in configs:
-        engine.run_config(keys)
-    print(json.dumps({"t_ours": time.time() - t0, "backend":
-                      jax.default_backend()}), flush=True)
+    t_fit = t_pred = 0.0
+    for keys in CONFIGS:
+        res = engine.run_config(keys)
+        t_fit += res[0] * engine.n_folds
+        t_pred += res[1] * engine.n_folds
+    t_scores = time.time() - t0
+
+    # SHAP stage (auto impl: the Pallas kernel on TPU, XLA elsewhere).
+    n_explain = min(SHAP_EXPLAIN, n_tests)
+    for keys in cfg.SHAP_CONFIGS:  # warm-up compile per config
+        pipeline.shap_for_config(keys, feats, labels,
+                                 tree_overrides=overrides,
+                                 n_explain=n_explain)
+        print(f"warmed shap {keys[4]}", file=sys.stderr, flush=True)
+    t0 = time.time()
+    for keys in cfg.SHAP_CONFIGS:
+        pipeline.shap_for_config(keys, feats, labels,
+                                 tree_overrides=overrides,
+                                 n_explain=n_explain)
+    t_shap = time.time() - t0
+
+    print(json.dumps({
+        "t_scores": round(t_scores, 3), "t_shap": round(t_shap, 3),
+        "t_fit": round(t_fit, 3), "t_predict": round(t_pred, 3),
+        "backend": jax.default_backend(),
+    }), flush=True)
 
 
 def probe():
@@ -170,7 +241,7 @@ def probe():
 
     Also requires a non-CPU default backend: if JAX silently comes up
     CPU-only, the full-ensemble worker would burn both timeouts on a sweep
-    the CPU can't finish — route straight to the DT fallback instead."""
+    the CPU can't finish — route straight to the reduced-size fallback."""
     code = ("import jax, jax.numpy as jnp;"
             "assert jax.default_backend() != 'cpu', 'cpu-only backend';"
             "x = jnp.ones((256, 256));"
@@ -185,13 +256,13 @@ def probe():
         return False, "probe timeout (tunnel wedged?)"
 
 
-def run_worker(config_idx, env_extra=None):
+def run_worker(n_tests, n_trees, env_extra=None):
     env = dict(os.environ)
     env.update(env_extra or {})
     try:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--worker",
-             ",".join(map(str, config_idx))],
+             str(n_tests), str(n_trees)],
             timeout=WORKER_TIMEOUT_S, capture_output=True, text=True,
             cwd=REPO, env=env,
         )
@@ -205,17 +276,11 @@ def run_worker(config_idx, env_extra=None):
         return None, (r.stdout or "")[-400:]
 
 
-DT_IDX = [i for i, k in enumerate(CONFIGS) if k[4] == "Decision Tree"]
-
-
 def main():
-    feats, labels, projects, names, pids = make_data()
-    t_base = sklearn_baseline(feats, labels, CONFIGS)
-
-    detail = {"t_sklearn_s": round(sum(t_base), 2), "n_tests": N_TESTS}
+    detail = {}
     result, err = None, None
-    idx = list(range(len(CONFIGS)))
-    tag = f"scores_probe_sweep_{len(CONFIGS)}cfg_n{N_TESTS}"
+    n, t = N_TESTS, N_TREES
+    tag = f"scores_shap_probe_{len(CONFIGS)}cfg_n{n}"
 
     if os.environ.get("BENCH_DEVICE") == "cpu":
         detail["tpu_probe"] = "disabled"  # operator opt-out, not a failure
@@ -225,43 +290,57 @@ def main():
         if not probe_ok:
             detail["tpu_probe"] = probe_err  # wedged tunnel vs cpu-only etc.
     if probe_ok:
-        result, err = run_worker(idx)
+        result, err = run_worker(n, t)
         if result is None:
             detail["tpu_attempt_1"] = err
-            result, err = run_worker(idx)  # faults can be transient
+            result, err = run_worker(n, t)  # faults can be transient
             if result is None:
                 detail["tpu_attempt_2"] = err
 
     if result is None:
-        # Fallback: the two Decision Tree configs on the CPU backend — the
-        # ensembles are too slow to compile+run on CPU within the bench
-        # budget, but a DT-only subset still yields a real end-to-end
-        # measurement against the matching sklearn subset (reported
-        # honestly via the metric name + detail.backend).
-        idx = DT_IDX
-        tag = f"scores_probe_dt_{len(idx)}cfg_n{N_TESTS}"
-        result, err = run_worker(idx, {
+        # Fallback: the SAME pipeline — all three model families and both
+        # SHAP configs — on the CPU backend, with N and ensemble size scaled
+        # down on BOTH sides (honest apples-to-apples at reduced scale).
+        n, t = FB_N_TESTS, FB_N_TREES
+        tag = f"scores_shap_probe_fb_{len(CONFIGS)}cfg_n{n}_t{t}"
+        result, err = run_worker(n, t, {
             "JAX_PLATFORMS": "cpu",
             "PALLAS_AXON_POOL_IPS": "",  # empty disables the tunnel hook
         })
         if result is None:
             print(json.dumps({
                 "metric": tag + "_speedup",
-                "value": 0.0, "unit": "x_vs_single_host_sklearn",
+                "value": 0.0, "unit": "x_vs_single_host_cpu_stack",
                 "vs_baseline": 0.0,
                 "detail": {**detail, "error": err},
             }))
             return
 
-    t_ours = result["t_ours"]
-    t_sk = sum(t_base[i] for i in idx)
-    speedup = t_sk / t_ours if t_ours > 0 else float("inf")
-    detail.update(t_ours_s=round(t_ours, 2), t_sklearn_subset_s=round(t_sk, 2),
-                  backend=result.get("backend"))
+    feats, labels, _, _, _ = make_data(n)
+    t_base_scores = cpu_scores_baseline(feats, labels, CONFIGS, t)
+    t_base_shap = cpu_shap_baseline(feats, labels, t)
+
+    t_ours = result["t_scores"] + result["t_shap"]
+    t_base = sum(t_base_scores) + sum(t_base_shap)
+    speedup = t_base / t_ours if t_ours > 0 else float("inf")
+    detail.update(
+        n_tests=n, n_trees=t, n_explain=min(SHAP_EXPLAIN, n),
+        shap_baseline="numpy path-dependent oracle (shap not installed)",
+        t_cpu_scores_s=round(sum(t_base_scores), 2),
+        t_cpu_shap_s=round(sum(t_base_shap), 2),
+        t_ours_scores_s=result["t_scores"], t_ours_shap_s=result["t_shap"],
+        t_ours_fit_s=result.get("t_fit"),
+        t_ours_predict_s=result.get("t_predict"),
+        scores_speedup=round(sum(t_base_scores) / result["t_scores"], 3)
+        if result["t_scores"] else None,
+        shap_speedup=round(sum(t_base_shap) / result["t_shap"], 3)
+        if result["t_shap"] else None,
+        backend=result.get("backend"),
+    )
     print(json.dumps({
         "metric": tag + "_speedup",
         "value": round(speedup, 3),
-        "unit": "x_vs_single_host_sklearn",
+        "unit": "x_vs_single_host_cpu_stack",
         "vs_baseline": round(speedup, 3),
         "detail": detail,
     }))
@@ -269,6 +348,6 @@ def main():
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
-        worker([int(i) for i in sys.argv[2].split(",")])
+        worker(int(sys.argv[2]), int(sys.argv[3]))
     else:
         main()

@@ -94,12 +94,13 @@ def _dump(obj, path):
 
 def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
                     tree_overrides=None, seed=0, sample_chunk=512,
-                    impl="auto"):
+                    impl="auto", n_explain=None):
     """One SHAP config (reference get_shap experiment.py:504-517): preprocess
-    full data, fit on the balanced full set, explain every original sample.
-    Returns the class-0 values array [N, F'] (the reference's
-    ``shap_values(features)[0]`` convention). ``impl`` selects the Tree SHAP
-    backend (ops/treeshap.py: "pallas" kernel / "xla" / "auto")."""
+    full data, fit on the balanced full set, explain every original sample
+    (or the first ``n_explain`` — benchmark sizing). Returns the class-0
+    values array [N, F'] (the reference's ``shap_values(features)[0]``
+    convention). ``impl`` selects the Tree SHAP backend (ops/treeshap.py:
+    "pallas" kernel / "xla" / "auto")."""
     fl, cols, prep, bal, spec = cfg.resolve_config(config_keys)
     if tree_overrides and spec.name in tree_overrides:
         spec = type(spec)(spec.name, tree_overrides[spec.name], spec.bootstrap,
@@ -115,18 +116,23 @@ def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
 
     kb, kf = jax.random.split(key)
     xs, ys, ws = resample(xp, y, np.ones(n, np.float32), bal, kb, 2 * n)
-    forest = trees.fit_forest(
-        xs, ys, ws, kf, n_trees=spec.n_trees, bootstrap=spec.bootstrap,
+    fit_kw = dict(
+        n_trees=spec.n_trees, bootstrap=spec.bootstrap,
         random_splits=spec.random_splits, sqrt_features=spec.sqrt_features,
         max_depth=max_depth, max_nodes=4 * n,
-        # Largest divisor of n_trees within the memory budget: no chunk
-        # padding (a chunk of 64 would fit-and-discard 28 extra trees).
-        tree_chunk=max(c for c in range(1, min(64, spec.n_trees) + 1)
-                       if spec.n_trees % c == 0),
     )
+    if spec.n_trees > 1:
+        # Ensembles fit via the MXU histogram grower — same policy as the
+        # sweep (parallel/sweep.py _make_config_fns). A single unchunked
+        # 100-tree fit is one fold's worth of the sweep's 320-instance
+        # budget, so no tree_chunk is needed here.
+        forest = trees.fit_forest_hist(xs, ys, ws, kf, **fit_kw)
+    else:
+        forest = trees.fit_forest(xs, ys, ws, kf, **fit_kw)
+    x_explain = xp if n_explain is None else xp[:n_explain]
     return np.asarray(
-        treeshap.forest_shap_class0(forest, xp, sample_chunk=sample_chunk,
-                                    impl=impl)
+        treeshap.forest_shap_class0(forest, x_explain,
+                                    sample_chunk=sample_chunk, impl=impl)
     )
 
 

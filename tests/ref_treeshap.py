@@ -1,0 +1,120 @@
+"""Numpy path-dependent Tree SHAP — the CPU reference for the bench and an
+independent cross-check of ops/treeshap.py.
+
+Implements the classic recursive EXTEND/UNWIND algorithm (the one inside
+shap.TreeExplainer's C extension with feature_perturbation=
+'tree_path_dependent'; shap itself is not installed in this environment, so
+like tests/ref_resamplers.py this file re-derives the semantics in numpy).
+Vectorized over samples: the permutation-weight vector is [path_len, S]
+(samples differ in their branch decisions, i.e. one-fractions), while zero
+fractions and split metadata are shared. Complexity O(nodes x depth^2 x S)
+per tree — the same asymptotics as the C extension, amortized over the
+sample axis.
+
+Conventions: a path of ``n`` elements includes the dummy element at index 0;
+weight arrays are [n, S].
+"""
+
+import numpy as np
+
+
+def _extend(w, z, o):
+    """Append an element with (zero_frac z: scalar, one_frac o: [S]) to path
+    weights w [n, S] -> [n+1, S]."""
+    n, s = w.shape
+    out = np.zeros((n + 1, s), w.dtype)
+    j = np.arange(1, n + 1, dtype=w.dtype)[:, None]
+    out[1:] += o[None, :] * w * (j / (n + 1))
+    i = np.arange(n, dtype=w.dtype)[:, None]
+    out[:n] += z * w * ((n - i) / (n + 1))
+    return out
+
+
+def _unwind_weights(w, z, o):
+    """Inverse of _extend for the element with fractions (z, o): w [n, S]
+    -> [n-1, S]."""
+    n, s = w.shape
+    d = n - 1
+    out = np.empty((d, s), w.dtype)
+    nxt = w[d].copy()
+    o_is0 = o == 0
+    o_safe = np.where(o_is0, 1.0, o)
+    for j in range(d - 1, -1, -1):
+        tmp_o = nxt * (d + 1) / ((j + 1) * o_safe)
+        nxt = np.where(o_is0, nxt, w[j] - tmp_o * z * (d - j) / (d + 1))
+        tmp_z = (w[j] * (d + 1) / (z * (d - j))) if z > 0 else np.zeros(s)
+        out[j] = np.where(o_is0, tmp_z, tmp_o)
+    return out
+
+
+def _unwound_sum(w, z, o):
+    """sum(_unwind_weights(w, z, o)) without materializing it."""
+    return _unwind_weights(w, z, o).sum(axis=0)
+
+
+def tree_shap_class0(children_left, children_right, feature, threshold,
+                     value01, x):
+    """phi [S, F] for one tree's class-0 probability. ``value01`` [M, 2] are
+    per-node cover-weighted class counts; leaf p0 = value[0] / value.sum()."""
+    x = np.asarray(x, np.float64)
+    s, n_features = x.shape
+    value01 = np.asarray(value01, np.float64)
+    cover = value01.sum(-1)
+    phi = np.zeros((s, n_features))
+
+    def recurse(node, w, feats, zs, os_):
+        # w [n, S]; feats/zs/os_: per-element metadata lists (index 0 dummy).
+        if feature[node] < 0:  # leaf
+            p0 = value01[node, 0] / max(cover[node], 1e-30)
+            for k in range(1, len(feats)):
+                u = _unwound_sum(w, zs[k], os_[k])
+                phi[:, feats[k]] += (os_[k] - zs[k]) * u * p0
+            return
+
+        f = int(feature[node])
+        le, ri = int(children_left[node]), int(children_right[node])
+        goes_left = x[:, f] <= threshold[node]
+
+        for child, branch_ind in ((le, goes_left), (ri, ~goes_left)):
+            z = cover[child] / max(cover[node], 1e-30)
+            o = branch_ind.astype(np.float64)
+            if f in feats[1:]:
+                # duplicate feature on the path: unwind its previous
+                # occurrence and fold the fractions into the new element
+                k = feats.index(f, 1)
+                w2 = _unwind_weights(w, zs[k], os_[k])
+                feats2 = feats[:k] + feats[k + 1:]
+                zs2 = zs[:k] + zs[k + 1:]
+                os2 = os_[:k] + os_[k + 1:]
+                z2, o2 = z * zs[k], o * os_[k]
+            else:
+                w2, feats2, zs2, os2, z2, o2 = w, feats, zs, os_, z, o
+            recurse(child, _extend(w2, z2, o2), feats2 + [f], zs2 + [z2],
+                    os2 + [o2])
+
+    w0 = np.ones((1, s))
+    recurse(0, w0, [-1], [1.0], [np.ones(s)])
+    return phi
+
+
+def forest_shap_class0_ref(forest_trees, x):
+    """Mean class-0 SHAP over trees given as
+    (children_left, children_right, feature, threshold, value01) tuples."""
+    phis = [tree_shap_class0(*t, x) for t in forest_trees]
+    return np.mean(phis, axis=0)
+
+
+def sklearn_forest_trees(model):
+    """Extract (left, right, feature, threshold, value01) per tree from a
+    fitted sklearn forest/tree, with value01 rescaled to cover-weighted class
+    counts (tree_.value rows are class distributions for forests)."""
+    ests = getattr(model, "estimators_", [model])
+    out = []
+    for est in ests:
+        t = est.tree_
+        v = t.value[:, 0, :]
+        counts = v / np.maximum(v.sum(-1, keepdims=True), 1e-30) \
+            * t.weighted_n_node_samples[:, None]
+        out.append((t.children_left.copy(), t.children_right.copy(),
+                    t.feature.copy(), t.threshold.copy(), counts))
+    return out

@@ -1,0 +1,85 @@
+"""Validate the numpy Tree SHAP reference (tests/ref_treeshap.py) — it is
+the CPU baseline the bench measures against, so it gets the same two checks
+as the production implementation: the brute-force subset-enumeration oracle
+on tiny trees, and agreement with ops/treeshap.py's XLA formulation (itself
+oracle-validated) on deeper forests — including a sklearn-fitted forest via
+sklearn_forest_trees, the exact shape the bench uses."""
+
+import numpy as np
+import jax
+import pytest
+from sklearn.ensemble import RandomForestClassifier
+
+from flake16_framework_tpu.ops.trees import fit_forest
+from flake16_framework_tpu.ops.treeshap import forest_shap_class0
+
+from ref_treeshap import (
+    forest_shap_class0_ref, sklearn_forest_trees, tree_shap_class0,
+)
+from test_treeshap import _np_tree, brute_force_shap
+
+
+@pytest.mark.parametrize("seed,n,f", [(0, 40, 4), (2, 30, 3)])
+def test_ref_single_tree_matches_brute_force(seed, n, f):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f)
+    y = (x[:, 0] + 0.5 * x[:, -1] + 0.3 * rng.randn(n)) > 0
+    forest = fit_forest(
+        x, y, np.ones(n), jax.random.PRNGKey(seed), n_trees=1,
+        bootstrap=False, random_splits=False, sqrt_features=False,
+        max_depth=6, max_nodes=64,
+    )
+    feat, thr, left, right, value = _np_tree(forest)
+    xq = rng.randn(4, f)
+    phi = tree_shap_class0(left, right, feat, thr, value, xq)
+    for q in range(4):
+        np.testing.assert_allclose(
+            phi[q], brute_force_shap((feat, thr, left, right, value), xq[q], f),
+            atol=1e-8,
+        )
+
+
+def test_ref_matches_xla_on_forest():
+    rng = np.random.RandomState(5)
+    n, f = 150, 8
+    x = rng.randn(n, f)
+    y = (x[:, 1] - x[:, 3] + 0.4 * rng.randn(n)) > 0
+    forest = fit_forest(
+        x, y, np.ones(n), jax.random.PRNGKey(2), n_trees=5, bootstrap=True,
+        random_splits=False, sqrt_features=True, max_depth=12, max_nodes=512,
+    )
+    xq = rng.randn(20, f)
+    ours = np.asarray(forest_shap_class0(forest, xq, impl="xla"))
+    # _np_tree order is (feature, threshold, left, right, value); the ref
+    # signature is (left, right, feature, threshold, value)
+    trees_np = [
+        (t[2], t[3], t[0], t[1], t[4])
+        for t in (_np_tree(forest, i) for i in range(5))
+    ]
+    ref = forest_shap_class0_ref(trees_np, xq)
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def test_ref_on_sklearn_forest_local_accuracy():
+    # The bench path: sklearn-fitted RF -> sklearn_forest_trees -> numpy SHAP.
+    # Check the local-accuracy identity sum_f phi = p0(x) - E[p0] per sample.
+    rng = np.random.RandomState(8)
+    n, f = 200, 6
+    x = rng.randn(n, f)
+    y = (x[:, 0] + x[:, 4] + 0.5 * rng.randn(n)) > 0
+    m = RandomForestClassifier(n_estimators=10, random_state=0).fit(x, y)
+    trees_np = sklearn_forest_trees(m)
+    xq = rng.randn(25, f)
+    phi = forest_shap_class0_ref(trees_np, xq)
+    p0 = m.predict_proba(xq)[:, 0]
+    # E[p0] per tree = cover-weighted mean of leaf p0
+    bases = []
+    for le, ri, fe, th, va in trees_np:
+        leaves = fe < 0
+        cover = va.sum(-1)
+        p0_leaf = va[:, 0] / np.maximum(cover, 1e-30)
+        bases.append(
+            (p0_leaf[leaves] * cover[leaves]).sum() / cover[leaves].sum()
+        )
+    base = np.mean(bases)
+    np.testing.assert_allclose(phi.sum(1), p0 - base, atol=1e-8)
