@@ -47,9 +47,13 @@ PLUGIN_BLACKLIST = (
     "-p", "no:rerunfailures",
 )
 
-PLUGINS = (
-    os.path.join(WORK_DIR, "showflakes"), os.path.join(WORK_DIR, "testinspect")
-)
+# The reference installs two standalone plugin packages into every subject
+# venv; here both pytest plugins live inside this package (plugins/ — jax-free
+# by design), so setup installs the framework source tree itself with
+# --no-deps and the plugins activate via the pytest11 entry points declared in
+# pyproject.toml. FRAMEWORK_DIR is where the Dockerfile copies the tree.
+FRAMEWORK_DIR = os.path.join(WORK_DIR, "framework")
+PLUGINS = (FRAMEWORK_DIR,)
 
 # The 16 Flake16 features, column order fixed (reference experiment.py:65-71):
 # cols 0-2 from coverage, 3-8 from rusage, 9-15 static.

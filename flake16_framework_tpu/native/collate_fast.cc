@@ -8,9 +8,11 @@
 //   numbits_to_lines(bytes) -> set[int]
 //   coverage_features(cov: {file: set[int]}, test_files, churn) -> (n, n, n)
 //
-// Built on demand by native/__init__.py with g++; runner/collate.py falls
-// back to the Python implementations when the toolchain or build is
-// unavailable, and tests assert native/python parity.
+// Built on demand by native/__init__.py with g++; runner/collate.py
+// dispatches numbits_to_lines / coverage_features here and falls back to
+// its Python implementations when the toolchain or build is unavailable.
+// tests/test_native_collate.py asserts native/python parity and the
+// micro-bench win.
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>

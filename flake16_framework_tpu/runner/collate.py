@@ -33,14 +33,13 @@ import pickle
 import sqlite3
 from dataclasses import dataclass, field
 
+from flake16_framework_tpu import native
 from flake16_framework_tpu.constants import (
     DATA_DIR, FLAKY, N_RUNS, NON_FLAKY, OD_FLAKY, SUBJECTS_DIR, TESTS_FILE,
 )
 
 
-def numbits_to_lines(blob):
-    """Decode a coverage.py numbits blob: bit k of byte n set => line 8n+k
-    covered. Native re-implementation of the numbits codec's decode side."""
+def _numbits_to_lines_py(blob):
     out = set()
     for byte_i, byte in enumerate(blob):
         while byte:
@@ -48,6 +47,18 @@ def numbits_to_lines(blob):
             out.add(byte_i * 8 + low.bit_length() - 1)
             byte &= byte - 1
     return out
+
+
+def numbits_to_lines(blob):
+    """Decode a coverage.py numbits blob: bit k of byte n set => line 8n+k
+    covered. Re-implementation of the numbits codec's decode side; the L3
+    hot loop, so it dispatches to the C fast path (native/collate_fast.cc)
+    when the on-demand build is available, pure Python otherwise
+    (tests/test_native_collate.py asserts the two agree)."""
+    mod = native.load()
+    if mod is not None:
+        return mod.numbits_to_lines(blob)
+    return _numbits_to_lines_py(blob)
 
 
 @dataclass
@@ -203,9 +214,7 @@ def label_test(runs, n_runs=N_RUNS):
     return max(base.min_fail_run, base.min_pass_run), FLAKY
 
 
-def coverage_features(coverage, test_files, churn):
-    """(covered lines, churn-weighted covered changes, source-only covered
-    lines) — the 3 coverage features (component 12)."""
+def _coverage_features_py(coverage, test_files, churn):
     n_lines = n_changes = n_src_lines = 0
 
     for file_name, lines in coverage.items():
@@ -216,6 +225,16 @@ def coverage_features(coverage, test_files, churn):
             n_src_lines += len(lines)
 
     return n_lines, n_changes, n_src_lines
+
+
+def coverage_features(coverage, test_files, churn):
+    """(covered lines, churn-weighted covered changes, source-only covered
+    lines) — the 3 coverage features (component 12). Native fast path when
+    available, like numbits_to_lines."""
+    mod = native.load()
+    if mod is not None:
+        return mod.coverage_features(coverage, test_files, churn)
+    return _coverage_features_py(coverage, test_files, churn)
 
 
 def assemble_tests(projects, n_runs=N_RUNS):

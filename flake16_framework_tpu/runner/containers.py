@@ -54,8 +54,14 @@ def _venv_env(proj):
 
 def provision_subject(subject, exec_fn=sp.run):
     """Build one subject's pinned virtualenv (L1; reference setup_project
-    experiment.py:110-125): venv, clone @ sha, pinned pip, both plugins,
-    subject editable install."""
+    experiment.py:110-125): venv, clone @ sha, pinned pip, plugins,
+    subject editable install.
+
+    Per-subject pins (``subjects/<proj>/requirements.txt`` — a pip freeze of
+    the resolved env at the pinned SHA) belong to a study run, not to the
+    framework; when absent, setup falls back to the subject's own unpinned
+    dependency resolution plus the plugins' one runtime dep (psutil) — fine
+    for smoke runs, not for replicating the study byte-for-byte."""
     paths = subject_paths(subject.name)
     env = _venv_env(subject.name)
 
@@ -66,8 +72,14 @@ def provision_subject(subject, exec_fn=sp.run):
 
     package_dir = os.path.join(paths["checkout"], subject.package_dir)
     exec_fn([*PIP_INSTALL, PIP_VERSION], env=env, check=True)
-    exec_fn([*PIP_INSTALL, "-r", paths["requirements"]], env=env, check=True)
-    exec_fn([*PIP_INSTALL, *PLUGINS, "-e", package_dir], env=env, check=True)
+    if os.path.exists(paths["requirements"]):
+        exec_fn([*PIP_INSTALL, "-r", paths["requirements"]], env=env,
+                check=True)
+        exec_fn([*PIP_INSTALL, *PLUGINS, "-e", package_dir], env=env,
+                check=True)
+    else:
+        exec_fn(["pip", "install", *PLUGINS, "psutil", "-e", package_dir],
+                env=env, check=True)
 
 
 def _provision_worker(subject, exec_fn=sp.run):

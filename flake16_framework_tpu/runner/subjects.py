@@ -3,12 +3,23 @@
 Behavioral port of the reference's registry (component 2, SURVEY.md §2;
 /root/reference/experiment.py:103-107 + subjects.txt): one CSV line per subject
 ``owner/repo,sha,package_dir,cmd1[,cmd2...]`` where the trailing commands are
-the in-container setup steps plus the final pytest invocation.
+the in-container setup steps plus the final pytest invocation. Lines starting
+with ``#`` are comments (an extension over the reference format).
+
+The registry data ships with the package (``flake16_framework_tpu/
+subjects.txt`` — the study's 26 subjects); a ``subjects.txt`` in the working
+directory overrides it, matching the reference's cwd-relative lookup.
 """
 
+import os
 from dataclasses import dataclass
 
 from flake16_framework_tpu.constants import SUBJECTS_FILE
+
+PACKAGED_SUBJECTS_FILE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "subjects.txt",
+)
 
 
 @dataclass(frozen=True)
@@ -32,8 +43,11 @@ def parse_subject_line(line):
     )
 
 
-def iter_subjects(path=SUBJECTS_FILE):
+def iter_subjects(path=None):
+    if path is None:
+        path = (SUBJECTS_FILE if os.path.exists(SUBJECTS_FILE)
+                else PACKAGED_SUBJECTS_FILE)
     with open(path, "r") as fd:
         for line in fd:
-            if line.strip():
+            if line.strip() and not line.lstrip().startswith("#"):
                 yield parse_subject_line(line)

