@@ -26,7 +26,10 @@ SUBJECTS_DIR = os.path.join(WORK_DIR, "subjects")
 CONT_DATA_DIR = os.path.join(WORK_DIR, DATA_DIR)
 
 CONT_TIMEOUT = 7200
-PIP_VERSION = "pip==21.2.1"
+# Pinned for reproducibility like the reference's pip==21.2.1, but at a
+# version that supports the Python 3.12 venvs this framework's containers
+# use (21.2's vendored pkg_resources breaks at import on 3.12).
+PIP_VERSION = "pip==24.0"
 IMAGE_NAME = "flake16framework"
 PIP_INSTALL = ["pip", "install", "-I", "--no-deps"]
 

@@ -75,7 +75,15 @@ def provision_subject(subject, exec_fn=sp.run):
     if os.path.exists(paths["requirements"]):
         exec_fn([*PIP_INSTALL, "-r", paths["requirements"]], env=env,
                 check=True)
-        exec_fn([*PIP_INSTALL, *PLUGINS, "-e", package_dir], env=env,
+        # testinspect's one runtime dep must exist even when the pins omit
+        # it; a pinned psutil (the normal case) is left untouched.
+        with open(paths["requirements"]) as fd:
+            pinned_psutil = any(
+                line.split("==")[0].strip().lower() == "psutil"
+                for line in fd
+            )
+        extra = [] if pinned_psutil else ["psutil"]
+        exec_fn([*PIP_INSTALL, *PLUGINS, *extra, "-e", package_dir], env=env,
                 check=True)
     else:
         exec_fn(["pip", "install", *PLUGINS, "psutil", "-e", package_dir],

@@ -42,3 +42,15 @@ def test_dryrun_multichip_driver_style():
     assert r.returncode == 0, f"stdout={r.stdout[-800:]}\nstderr={r.stderr[-800:]}"
     assert "dryrun_multichip OK (stratified): 8 devices" in r.stdout
     assert "dryrun_multichip OK (lopo): 8 devices" in r.stdout
+
+
+def test_entry_lowers_single_device():
+    # The driver compile-checks entry() on one chip; lower it the same way
+    # (jit + lower on this process's backend) so a tracing regression fails
+    # here rather than in the driver's compile check.
+    import jax
+
+    import __graft_entry__
+
+    fn, example_args = __graft_entry__.entry()
+    jax.jit(fn).lower(*example_args)

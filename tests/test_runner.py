@@ -135,7 +135,7 @@ def test_provision_subject_commands():
     assert any(j.startswith("virtualenv") for j in joined)
     assert any("git clone https://github.com/o/p" in j for j in joined)
     assert any("git reset --hard abc" in j for j in joined)
-    assert any("pip install -I --no-deps pip==21.2.1" in j for j in joined)
+    assert any("pip install -I --no-deps pip==" in j for j in joined)
     assert any("-e" in c for c, _ in rec.calls)
 
 
