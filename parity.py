@@ -153,10 +153,23 @@ def ours_config_f1s(feats, labels, pids, keys, *, n_trees, seeds):
 
 
 def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
-               nod_bump=2.5, od_bump=1.8, noise_sigma=0.35, configs=None):
-    """Seed-averaged F1 comparison. Returns a report dict per config."""
+               nod_bump=2.5, od_bump=1.8, noise_sigma=0.35, configs=None,
+               sklearn_cache=None):
+    """Seed-averaged F1 comparison. Returns a report dict per config.
+
+    ``sklearn_cache``: optional path to a JSON of precomputed sklearn-side
+    per-seed F1s ({"n_tests", "n_trees", "f1s": {"A/B/C/D/E": [...]}}) — the
+    CPU side takes ~1 h single-core at full size, so it can be produced
+    once and reused across ours-side (TPU) runs. Sizes must match."""
     from flake16_framework_tpu.utils.synth import make_dataset
 
+    cache = None
+    if sklearn_cache and os.path.exists(sklearn_cache):
+        with open(sklearn_cache) as fd:
+            cache = json.load(fd)
+        assert cache["n_tests"] == n_tests and cache["n_trees"] == n_trees, (
+            "sklearn cache sized differently than this run"
+        )
     feats, labels, pids = make_dataset(
         n_tests=n_tests, seed=data_seed, nod_bump=nod_bump, od_bump=od_bump,
         noise_sigma=noise_sigma,
@@ -167,9 +180,12 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
         ko = 1 if deterministic else k_ours
         ours = ours_config_f1s(feats, labels, pids, keys,
                                n_trees=n_trees, seeds=range(ko))
-        sk = [sklearn_config_f1(feats, labels, keys,
-                                n_trees=n_trees, seed=s)
-              for s in range(k_sk)]
+        if cache is not None:
+            sk = cache["f1s"]["/".join(keys)][:k_sk]
+        else:
+            sk = [sklearn_config_f1(feats, labels, keys,
+                                    n_trees=n_trees, seed=s)
+                  for s in range(k_sk)]
         o, s = np.array(ours), np.array(sk)
         se = float(np.sqrt(
             (o.std(ddof=1) ** 2 / len(o) if len(o) > 1 else 0.0)
@@ -192,7 +208,10 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
 def main():
     full = "--full" in sys.argv
     if full:
-        rep = run_parity(n_tests=4000, n_trees=100, k_ours=6, k_sk=6)
+        rep = run_parity(
+            n_tests=4000, n_trees=100, k_ours=6, k_sk=6,
+            sklearn_cache=os.environ.get("PARITY_SKLEARN_CACHE"),
+        )
         tol = 0.01
         out = {"tier": "full", "n_tests": 4000, "n_trees": 100,
                "tolerance": tol, "configs": rep,
