@@ -164,12 +164,18 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
     from flake16_framework_tpu.utils.synth import make_dataset
 
     cache = None
-    if sklearn_cache and os.path.exists(sklearn_cache):
+    if sklearn_cache:
+        # a typo'd path must not silently fall back to the ~1 h recompute
         with open(sklearn_cache) as fd:
             cache = json.load(fd)
-        assert cache["n_tests"] == n_tests and cache["n_trees"] == n_trees, (
-            "sklearn cache sized differently than this run"
-        )
+        params = dict(n_tests=n_tests, n_trees=n_trees, data_seed=data_seed,
+                      nod_bump=nod_bump, od_bump=od_bump,
+                      noise_sigma=noise_sigma)
+        for name, val in params.items():
+            got = cache.get(name, val)  # absent field = produced at defaults
+            assert got == val, (
+                f"sklearn cache {name}={got} != this run's {val}"
+            )
     feats, labels, pids = make_dataset(
         n_tests=n_tests, seed=data_seed, nod_bump=nod_bump, od_bump=od_bump,
         noise_sigma=noise_sigma,
@@ -181,7 +187,11 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
         ours = ours_config_f1s(feats, labels, pids, keys,
                                n_trees=n_trees, seeds=range(ko))
         if cache is not None:
-            sk = cache["f1s"]["/".join(keys)][:k_sk]
+            sk = cache["f1s"]["/".join(keys)]
+            assert len(sk) >= max(k_sk, 2), (
+                f"cache has {len(sk)} seeds for {keys}, need {k_sk}"
+            )
+            sk = sk[:k_sk]
         else:
             sk = [sklearn_config_f1(feats, labels, keys,
                                     n_trees=n_trees, seed=s)
