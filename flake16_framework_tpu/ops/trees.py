@@ -72,6 +72,30 @@ class Forest(NamedTuple):
     # derives its traversal length from this so fit/predict can't disagree.
 
 
+# Every Forest field with a tree axis (max_depth is broadcast metadata) —
+# the single source of truth for slicing/concatenating forests by tree.
+TREE_FIELDS = Forest._fields[:-1]
+
+
+def slice_trees(forest, lo, hi, axis=0):
+    """Forest restricted to trees [lo:hi] along ``axis`` (0 for a plain
+    [T, ...] forest, 1 for a fold-stacked [folds, T, ...] one)."""
+    idx = (slice(None),) * axis + (slice(lo, hi),)
+    return forest._replace(
+        **{f: getattr(forest, f)[idx] for f in TREE_FIELDS}
+    )
+
+
+def concat_trees(parts, axis=0):
+    """Concatenate Forests along the tree axis — the inverse of growing an
+    ensemble in key-table slices (fit_forest* ``tree_keys``)."""
+    return Forest(
+        *[jnp.concatenate([getattr(p, f) for p in parts], axis=axis)
+          for f in TREE_FIELDS],
+        parts[0].max_depth,
+    )
+
+
 def _exclusive_cumsum(x):
     return jnp.concatenate([jnp.zeros_like(x[:1]), jnp.cumsum(x)[:-1]])
 
@@ -632,12 +656,18 @@ def _fit_one_tree_hist(ohfb, bin_idx, edges, y01, w, key, *, random_splits,
 )
 def fit_forest_hist(x, y, w, key, *, n_trees, bootstrap, random_splits,
                     sqrt_features, max_depth=48, max_nodes=None,
-                    tree_chunk=None, n_bins=HIST_BINS, edges=None):
+                    tree_chunk=None, n_bins=HIST_BINS, edges=None,
+                    tree_keys=None):
     """Histogram-grower twin of ``fit_forest`` (same signature + ``n_bins``/
     ``edges``). ``edges`` [F, n_bins-1] may be precomputed (e.g. once per
     config from the full preprocessed matrix, shared across folds); derived
     from ``x`` when None. Returns the same ``Forest`` structure, so predict
-    and Tree SHAP are grower-agnostic."""
+    and Tree SHAP are grower-agnostic.
+
+    ``tree_keys`` [n_trees, 2] replaces the internal ``split(key, n_trees)``
+    so callers can grow a forest across several device dispatches (slices of
+    one key table) with bit-identical results — see sweep.py's
+    dispatch-chunked path."""
     n, f = x.shape
     if max_nodes is None:
         max_nodes = 2 * n
@@ -649,7 +679,8 @@ def fit_forest_hist(x, y, w, key, *, n_trees, bootstrap, random_splits,
         edges = quantile_edges(x, n_bins)
     ohfb, bin_idx = _bin_onehot(x, edges)
 
-    keys = jax.random.split(key, n_trees)
+    keys = jax.random.split(key, n_trees) if tree_keys is None else tree_keys
+    assert keys.shape[0] == n_trees, (keys.shape, n_trees)
 
     def one(k):
         kb, kg = jax.random.split(k)
@@ -704,7 +735,8 @@ def _bootstrap_weights(w, key):
     ),
 )
 def fit_forest(x, y, w, key, *, n_trees, bootstrap, random_splits,
-               sqrt_features, max_depth=48, max_nodes=None, tree_chunk=None):
+               sqrt_features, max_depth=48, max_nodes=None, tree_chunk=None,
+               tree_keys=None):
     """Fit an ensemble. x [N,F]; y [N] (bool/int); w [N] >= 0 sample weights
     (0 = row excluded). Returns Forest with [T, ...] leading axis.
 
@@ -718,6 +750,9 @@ def fit_forest(x, y, w, key, *, n_trees, bootstrap, random_splits,
     100-tree x 10-fold ensemble fit overruns TPU device memory, so
     sweep-level callers pass a chunk (results are identical — per-tree PRNG
     keys don't depend on the chunking).
+
+    ``tree_keys`` [n_trees, 2] replaces the internal ``split(key, n_trees)``
+    (see fit_forest_hist).
     """
     n, f = x.shape
     if max_nodes is None:
@@ -732,7 +767,8 @@ def fit_forest(x, y, w, key, *, n_trees, bootstrap, random_splits,
     order0 = jnp.argsort(x.T, axis=1, stable=True).astype(jnp.int32)
     xsorted = jnp.take_along_axis(x.T, order0, axis=1)
 
-    keys = jax.random.split(key, n_trees)
+    keys = jax.random.split(key, n_trees) if tree_keys is None else tree_keys
+    assert keys.shape[0] == n_trees, (keys.shape, n_trees)
 
     def one(k):
         kb, kg = jax.random.split(k)

@@ -29,6 +29,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from flake16_framework_tpu.ops.trees import slice_trees
+
 
 def extract_paths(feature, threshold, left, right, value, max_depth):
     """Tree arrays [M] -> per-leaf padded root-path steps.
@@ -211,7 +213,8 @@ def tree_shap_single(paths, x, n_features):
     return phi
 
 
-def forest_shap_class0(forest, x, *, sample_chunk=None, impl="auto"):
+def forest_shap_class0(forest, x, *, sample_chunk=None, impl="auto",
+                       tree_chunk=None):
     """Mean over trees of per-tree class-0 Tree SHAP — the ensemble
     soft-vote's probability decomposition (what shap_values(X)[0] returns for
     a sklearn forest).
@@ -225,10 +228,28 @@ def forest_shap_class0(forest, x, *, sample_chunk=None, impl="auto"):
     O(L*S*F) workspace is live; chunk samples via ``sample_chunk`` if even
     that is too large.
 
+    ``tree_chunk`` splits the forest into ceil(T/tree_chunk)-sized slices
+    explained in SEPARATE device dispatches (per-tree phis are additive, so
+    the weighted recombination is exact). This bounds single-dispatch
+    duration — the TPU tunnel faults on multi-minute dispatches (PROFILE.md)
+    — unlike ``sample_chunk``, which only bounds the live workspace *inside*
+    one dispatch.
+
     Both impls dispatch through module-level jits keyed on static shapes, so
     repeated explains (the 2 reference configs, the bench's steady-state
     timing) reuse one compiled program instead of re-lowering per call.
     """
+    t_total = forest.feature.shape[0]
+    if tree_chunk is not None and tree_chunk < t_total:
+        acc = None
+        for lo in range(0, t_total, tree_chunk):
+            sub = slice_trees(forest, lo, lo + tree_chunk)
+            c = sub.feature.shape[0]
+            phi = forest_shap_class0(sub, x, sample_chunk=sample_chunk,
+                                     impl=impl) * c
+            phi.block_until_ready()
+            acc = phi if acc is None else acc + phi
+        return acc / t_total
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     depth = int(forest.max_depth)  # static by construction (fit-time bound)

@@ -75,36 +75,81 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
     use_hist = spec.n_trees > 1
     tree_chunk = _auto_tree_chunk(spec, n_folds, tree_chunk, use_hist)
 
-    def fit_one(x, y_raw, flaky_label, prep_code, bal_code, key, train_mask):
+    def _prep(x, y_raw, flaky_label, prep_code):
         y = y_raw == flaky_label
         mu, wmat = fit_preprocess(x, prep_code)
         xp = transform(x, mu, wmat)
-        fold_keys = jax.random.split(key, n_folds)
         # Bin edges once per config from the full preprocessed matrix
         # (fold-independent by construction; the reference already fits
         # preprocessing on the full matrix, experiment.py:452-453).
         edges = trees.quantile_edges(xp) if use_hist else None
+        return y, xp, edges
 
-        def fold(fold_key, w_train):
-            kb, kf = jax.random.split(fold_key)
-            xs, ys, ws = resample(xp, y, w_train, bal_code, kb, cap)
-            if use_hist:
-                return trees.fit_forest_hist(
-                    xs, ys, ws, kf, n_trees=spec.n_trees,
-                    bootstrap=spec.bootstrap,
-                    random_splits=spec.random_splits,
-                    sqrt_features=spec.sqrt_features, max_depth=max_depth,
-                    max_nodes=max_nodes, tree_chunk=tree_chunk, edges=edges,
-                )
-            return trees.fit_forest(
-                xs, ys, ws, kf, n_trees=spec.n_trees,
-                bootstrap=spec.bootstrap, random_splits=spec.random_splits,
-                sqrt_features=spec.sqrt_features, max_depth=max_depth,
-                max_nodes=max_nodes, tree_chunk=tree_chunk,
-            )
+    def _fold_fit_trees(xs, ys, ws, edges, kf, tks):
+        """Grow one fold's trees from its resampled tensors. ``tks`` [c, 2]
+        explicit per-tree keys, or None to grow all spec.n_trees from ``kf``
+        (identical bits: the key table is split(kf, n_trees) either way)."""
+        c = spec.n_trees if tks is None else tks.shape[0]
+        chunk = tree_chunk if tks is None else min(tree_chunk or c, c)
+        kw = dict(
+            n_trees=c, bootstrap=spec.bootstrap,
+            random_splits=spec.random_splits,
+            sqrt_features=spec.sqrt_features, max_depth=max_depth,
+            max_nodes=max_nodes, tree_chunk=chunk, tree_keys=tks,
+        )
+        if use_hist:
+            return trees.fit_forest_hist(xs, ys, ws, kf, edges=edges, **kw)
+        return trees.fit_forest(xs, ys, ws, kf, **kw)
 
-        forest = jax.vmap(fold)(fold_keys, train_mask)
+    def _fold_fit(xp, y, bal_code, edges, fold_key, w_train, tks):
+        """One fold's resample+fit (the single-dispatch path)."""
+        kb, kf = jax.random.split(fold_key)
+        xs, ys, ws = resample(xp, y, w_train, bal_code, kb, cap)
+        return _fold_fit_trees(xs, ys, ws, edges, kf, tks)
+
+    def fit_one(x, y_raw, flaky_label, prep_code, bal_code, key, train_mask):
+        y, xp, edges = _prep(x, y_raw, flaky_label, prep_code)
+        fold_keys = jax.random.split(key, n_folds)
+        forest = jax.vmap(
+            lambda fk, wt: _fold_fit(xp, y, bal_code, edges, fk, wt, None)
+        )(fold_keys, train_mask)
         return forest, xp, y
+
+    def tree_keys_one(key):
+        """The full [n_folds, n_trees, 2] per-tree key table of ``fit_one``
+        (fold key -> (kb, kf) -> split(kf, n_trees)); slices of it drive
+        ``fit_trees_chunk`` across separate device dispatches."""
+        fold_keys = jax.random.split(key, n_folds)
+        kf = jax.vmap(lambda k: jax.random.split(k)[1])(fold_keys)
+        return jax.vmap(
+            lambda k: jax.random.split(k, spec.n_trees)
+        )(kf)
+
+    def prep_resample_one(x, y_raw, flaky_label, prep_code, bal_code, key,
+                          train_mask):
+        """Everything of ``fit_one`` up to the tree growth, once: preprocess,
+        bin edges, per-fold resample. Returns the [n_folds, cap, ...] train
+        tensors consumed by ``fit_trees_chunk`` (kept on device)."""
+        y, xp, edges = _prep(x, y_raw, flaky_label, prep_code)
+        fold_keys = jax.random.split(key, n_folds)
+
+        def f(fold_key, w_train):
+            kb, _ = jax.random.split(fold_key)
+            return resample(xp, y, w_train, bal_code, kb, cap)
+
+        xs, ys, ws = jax.vmap(f)(fold_keys, train_mask)
+        return xs, ys, ws, edges, xp, y
+
+    def fit_trees_chunk(xs, ys, ws, edges, tks):
+        """Grow only the trees whose keys are ``tks`` [n_folds, c, 2] from
+        the prepped fold tensors — a bounded-duration dispatch for
+        fault-envelope control (PROFILE.md: single dispatches past ~1 min
+        can fault the TPU tunnel). Concatenating chunk forests along the
+        tree axis reproduces ``fit_one``'s forest bit-for-bit."""
+        def f(xsi, ysi, wsi, tk):
+            return _fold_fit_trees(xsi, ysi, wsi, edges, None, tk)
+
+        return jax.vmap(f)(xs, ys, ws, tks)
 
     def score_one(forest, xp, y, test_mask, project_ids):
         preds = jax.vmap(lambda f: trees.predict(f, xp))(forest)
@@ -112,7 +157,8 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
             y, preds, test_mask, project_ids, n_projects
         )
 
-    return fit_one, score_one
+    return (fit_one, score_one, prep_resample_one, fit_trees_chunk,
+            tree_keys_one)
 
 
 def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
@@ -121,12 +167,17 @@ def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
 
     All config axes inside a family are traced ints; shapes depend only on
     (n, n_feat, spec) so each family compiles exactly once.
+
+    Returns (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys); the
+    last three drive the dispatch-chunked fit (SweepEngine.run_config with
+    ``dispatch_trees``): one prep+resample dispatch, then one bounded fit
+    dispatch per tree-key slice (compiled once per chunk width).
     """
-    fit_one, score_one = _make_config_fns(
+    fns = _make_config_fns(
         spec, n=n, n_projects=n_projects, cap=cap, max_depth=max_depth,
         n_folds=n_folds, tree_chunk=tree_chunk,
     )
-    return jax.jit(fit_one), jax.jit(score_one)
+    return tuple(jax.jit(f) for f in fns)
 
 
 def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
@@ -146,7 +197,7 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
     ``make_cv_fns``. B must be a multiple of the mesh "config" axis size;
     within a shard, configs ride a vmap axis.
     """
-    fit_one, score_one = _make_config_fns(
+    fit_one, score_one, *_ = _make_config_fns(
         spec, n=n, n_projects=n_projects, max_depth=max_depth,
         n_folds=n_folds, tree_chunk=tree_chunk,
     )
@@ -200,7 +251,8 @@ class SweepEngine:
 
     def __init__(self, features, labels_raw, projects, project_names,
                  project_ids, *, mesh=None, max_depth=48, seed=0,
-                 n_folds=None, tree_overrides=None, cv="stratified"):
+                 n_folds=None, tree_overrides=None, cv="stratified",
+                 dispatch_trees=None):
         self.features = np.asarray(features, dtype=np.float32)
         self.labels_raw = np.asarray(labels_raw, dtype=np.int32)
         self.projects = projects
@@ -210,6 +262,11 @@ class SweepEngine:
         self.max_depth = max_depth
         self.seed = seed
         self.cv = cv
+        # Upper bound on trees grown per device dispatch in run_config
+        # (ensembles split into ceil(T/dispatch_trees) fit dispatches,
+        # bit-identical results). Bounds single-dispatch duration: the TPU
+        # tunnel faults on multi-minute dispatches (PROFILE.md).
+        self.dispatch_trees = dispatch_trees
         # tests shrink ensembles: {"Random Forest": 10, ...}
         self.tree_overrides = tree_overrides or {}
         self._fns = {}
@@ -266,7 +323,8 @@ class SweepEngine:
         """Run one config; returns (t_train, t_test, scores, scores_total)
         in the reference scores.pkl value schema (README.rst:78-134)."""
         fl_name, fs_name, prep_name, bal_name, model_name = config_keys
-        (cv_fit, cv_score), cols = self._get_fns(fs_name, model_name)
+        (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys), cols = \
+            self._get_fns(fs_name, model_name)
 
         x = jnp.asarray(self.features[:, cols])
         train_mask, test_mask = self._masks[fl_name]
@@ -274,16 +332,34 @@ class SweepEngine:
             jax.random.PRNGKey(self.seed),
             list(cfg.iter_config_keys()).index(tuple(config_keys)),
         )
-
-        t0 = time.time()
-        forest, xp, y = cv_fit(
+        fit_args = (
             x, jnp.asarray(self.labels_raw),
             jnp.int32(cfg.FLAKY_TYPES[fl_name]),
             jnp.int32(cfg.PREPROCESSINGS[prep_name]),
             jnp.int32(cfg.BALANCINGS[bal_name]),
             key, jnp.asarray(train_mask),
         )
-        jax.block_until_ready(forest)
+        n_trees = self._spec(model_name).n_trees
+        dc = self.dispatch_trees
+
+        t0 = time.time()
+        if dc is not None and n_trees > dc:
+            # Dispatch-chunked fit: one prep+resample dispatch, then
+            # ceil(T/dc) bounded-duration tree-growth dispatches; forests
+            # concatenated on the tree axis (bit-identical to the
+            # single-dispatch path — the key table is shared).
+            xs, ys, ws, edges, xp, y = cv_prep(*fit_args)
+            tks = cv_tree_keys(key)
+            parts = []
+            for lo in range(0, n_trees, dc):
+                forest_c = cv_fit_chunk(xs, ys, ws, edges,
+                                        tks[:, lo:lo + dc])
+                jax.block_until_ready(forest_c)
+                parts.append(forest_c)
+            forest = trees.concat_trees(parts, axis=1)
+        else:
+            forest, xp, y = cv_fit(*fit_args)
+            jax.block_until_ready(forest)
         t_train = time.time() - t0
 
         t0 = time.time()

@@ -94,13 +94,15 @@ def _dump(obj, path):
 
 def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
                     tree_overrides=None, seed=0, sample_chunk=512,
-                    impl="auto", n_explain=None):
+                    impl="auto", n_explain=None, shap_tree_chunk=None,
+                    fit_dispatch_trees=None):
     """One SHAP config (reference get_shap experiment.py:504-517): preprocess
     full data, fit on the balanced full set, explain every original sample
     (or the first ``n_explain`` — benchmark sizing). Returns the class-0
     values array [N, F'] (the reference's ``shap_values(features)[0]``
     convention). ``impl`` selects the Tree SHAP backend (ops/treeshap.py:
-    "pallas" kernel / "xla" / "auto")."""
+    "pallas" kernel / "xla" / "auto"); ``shap_tree_chunk`` splits the explain
+    into per-tree-slice dispatches (treeshap.forest_shap_class0)."""
     fl, cols, prep, bal, spec = cfg.resolve_config(config_keys)
     if tree_overrides and spec.name in tree_overrides:
         spec = type(spec)(spec.name, tree_overrides[spec.name], spec.bootstrap,
@@ -125,14 +127,32 @@ def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
         # Ensembles fit via the MXU histogram grower — same policy as the
         # sweep (parallel/sweep.py _make_config_fns). A single unchunked
         # 100-tree fit is one fold's worth of the sweep's 320-instance
-        # budget, so no tree_chunk is needed here.
-        forest = trees.fit_forest_hist(xs, ys, ws, kf, **fit_kw)
+        # budget, so no tree_chunk is needed here. ``fit_dispatch_trees``
+        # splits the fit into bounded-duration dispatches instead
+        # (bit-identical: explicit slices of the same tree-key table).
+        dc = fit_dispatch_trees
+        if dc is not None and dc < spec.n_trees:
+            tks = jax.random.split(kf, spec.n_trees)
+            # Bin edges once, not per chunk (bit-identical: every chunk
+            # would derive the same edges from the same xs).
+            edges = jax.jit(trees.quantile_edges)(xs)
+            parts = []
+            for lo in range(0, spec.n_trees, dc):
+                sub_kw = dict(fit_kw, n_trees=min(dc, spec.n_trees - lo),
+                              tree_keys=tks[lo:lo + dc], edges=edges)
+                part = trees.fit_forest_hist(xs, ys, ws, kf, **sub_kw)
+                jax.block_until_ready(part)
+                parts.append(part)
+            forest = trees.concat_trees(parts)
+        else:
+            forest = trees.fit_forest_hist(xs, ys, ws, kf, **fit_kw)
     else:
         forest = trees.fit_forest(xs, ys, ws, kf, **fit_kw)
     x_explain = xp if n_explain is None else xp[:n_explain]
     return np.asarray(
         treeshap.forest_shap_class0(forest, x_explain,
-                                    sample_chunk=sample_chunk, impl=impl)
+                                    sample_chunk=sample_chunk, impl=impl,
+                                    tree_chunk=shap_tree_chunk)
     )
 
 

@@ -171,8 +171,13 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
         params = dict(n_tests=n_tests, n_trees=n_trees, data_seed=data_seed,
                       nod_bump=nod_bump, od_bump=od_bump,
                       noise_sigma=noise_sigma)
+        # Absent field = cache produced at the *generation-time* defaults
+        # (run_parity's signature), NOT this run's values — falling back to
+        # `val` would make the check vacuous for non-default runs.
+        gen_defaults = dict(data_seed=7, nod_bump=2.5, od_bump=1.8,
+                            noise_sigma=0.35)
         for name, val in params.items():
-            got = cache.get(name, val)  # absent field = produced at defaults
+            got = cache.get(name, gen_defaults.get(name))
             assert got == val, (
                 f"sklearn cache {name}={got} != this run's {val}"
             )
@@ -191,7 +196,9 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
             assert len(sk) >= max(k_sk, 2), (
                 f"cache has {len(sk)} seeds for {keys}, need {k_sk}"
             )
-            sk = sk[:k_sk]
+            # Keep >= 2 seeds even if k_sk == 1: std(ddof=1) of one value
+            # is nan and would poison se_delta.
+            sk = sk[:max(k_sk, 2)]
         else:
             sk = [sklearn_config_f1(feats, labels, keys,
                                     n_trees=n_trees, seed=s)

@@ -96,3 +96,19 @@ def test_scores_shap_figures_end_to_end(workdir, monkeypatch):
 
     assert "\\addlegendentry{NOD}" in (workdir / "req-runs.tex").read_text()
     assert (workdir / "tests.tex").read_text().count("org/") == 4
+
+
+def test_shap_fit_dispatch_chunking_is_exact():
+    # fit_dispatch_trees splits the SHAP-stage ensemble fit into several
+    # dispatches over explicit key-table slices; the fitted forest — and so
+    # the explanation — must be bit-identical to the one-shot fit.
+    from flake16_framework_tpu import pipeline
+    from flake16_framework_tpu.utils.synth import make_dataset
+
+    feats, labels, _ = make_dataset(n_tests=150, seed=3)
+    keys = ("NOD", "Flake16", "Scaling", "SMOTE Tomek", "Extra Trees")
+    kw = dict(tree_overrides={"Extra Trees": 5}, n_explain=40, impl="xla")
+    a = pipeline.shap_for_config(keys, feats, labels, **kw)
+    b = pipeline.shap_for_config(keys, feats, labels, fit_dispatch_trees=2,
+                                 **kw)
+    np.testing.assert_array_equal(a, b)

@@ -156,3 +156,24 @@ def test_sharded_cv_fns_match_single_device(engine):
         res = engine.run_config(("NOD", "Flake16", p, b, "Decision Tree"))
         total = res[3][:3]
         np.testing.assert_array_equal(counts[i].sum(0), total)
+
+
+def test_dispatch_chunked_fit_matches_single_dispatch(engine):
+    # The dispatch-chunked fit path (SweepEngine dispatch_trees: ensembles
+    # grown across several bounded device dispatches, PROFILE.md fault
+    # envelope) must reproduce the single-dispatch scores bit-for-bit:
+    # both paths draw from the same per-tree key table.
+    chunked = sweep.SweepEngine(
+        engine.features, engine.labels_raw, engine.projects,
+        engine.project_names, engine.project_ids,
+        max_depth=24, tree_overrides={"Extra Trees": 8, "Random Forest": 8},
+        dispatch_trees=3,  # 8 trees -> dispatches of 3+3+2 (ragged tail)
+    )
+    for keys in [
+        ("OD", "Flake16", "Scaling", "SMOTE", "Random Forest"),
+        ("NOD", "FlakeFlagger", "None", "ENN", "Extra Trees"),
+    ]:
+        a = engine.run_config(keys)
+        b = chunked.run_config(keys)
+        assert a[3] == b[3], keys  # scores_total identical
+        assert a[2] == b[2], keys  # per-project scores identical

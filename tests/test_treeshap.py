@@ -155,3 +155,20 @@ def test_extract_paths_ratios():
     assert valid.sum() == 2  # one step each
     got = sorted(r[v][0] for r, v in zip(ratios, valid))
     np.testing.assert_allclose(got, [3 / 7, 4 / 7])
+
+def test_tree_chunked_shap_matches_unchunked():
+    # tree_chunk splits the explain into per-slice dispatches; per-tree phis
+    # are additive so the weighted recombination must match the one-shot
+    # result to float tolerance.
+    rng = np.random.RandomState(3)
+    n = 60
+    x = rng.randn(n, 5)
+    y = (x[:, 0] + 0.3 * rng.randn(n)) > 0
+    forest = fit_forest(
+        x, y, np.ones(n), jax.random.PRNGKey(5), n_trees=7, bootstrap=True,
+        random_splits=True, sqrt_features=True, max_depth=7, max_nodes=128,
+    )
+    xq = rng.randn(31, 5)
+    a = np.asarray(forest_shap_class0(forest, xq, impl="xla"))
+    b = np.asarray(forest_shap_class0(forest, xq, impl="xla", tree_chunk=3))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-9)
