@@ -1,0 +1,119 @@
+"""Incremental TPU dispatch-duration probe.
+
+Maps each stage of the bench worker (bench.py) onto the real device one
+bounded step at a time, each in its OWN subprocess with its own timeout, so
+a fault or wedge in one step cannot take down the measurement session — and
+so the step that wedges the tunnel is identified by name. Appends one JSON
+line per step to ``_scratch/hw_probe.jsonl``.
+
+Usage:
+    python tools/hw_probe.py            # all steps at bench size
+    python tools/hw_probe.py matmul dt  # just those steps
+
+Findings feed PROFILE.md ("device-fault envelope") and the choice of
+BENCH_DISPATCH_TREES. Steps use the same persistent compilation cache as
+bench.py, so a probe session also pre-warms the driver's bench run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "_scratch", "hw_probe.jsonl")
+
+STEP_SRC = {
+    # Tunnel health: one tiny matmul.
+    "matmul": """
+import jax, jax.numpy as jnp
+x = jnp.ones((512, 512))
+print('value', float((x @ x)[0, 0]))
+""",
+    # Exact-grower DT family: compile + steady fit+score at bench size.
+    "dt": """
+from probe_common import engine_and_keys
+eng, _ = engine_and_keys()
+import time
+keys = ('NOD', 'Flake16', 'None', 'None', 'Decision Tree')
+t0 = time.time(); eng.run_config(keys); print('compile_s', round(time.time() - t0, 2))
+t0 = time.time(); r = eng.run_config(keys); print('steady_s', round(time.time() - t0, 2))
+print('t_train_fold_s', round(r[0], 3))
+""",
+    # Histogram-grower RF: ONE chunked tree-growth dispatch (25 trees x 10
+    # folds) after prep, timed separately from its compile.
+    "rf_chunk": """
+from probe_common import engine_and_keys, chunk_fit_times
+for line in chunk_fit_times(('NOD', 'Flake16', 'Scaling', 'SMOTE',
+                             'Random Forest')):
+    print(line)
+""",
+    # Full RF config through run_config (all chunks + score).
+    "rf_full": """
+from probe_common import engine_and_keys
+eng, _ = engine_and_keys()
+import time
+keys = ('NOD', 'Flake16', 'Scaling', 'SMOTE', 'Random Forest')
+t0 = time.time(); eng.run_config(keys); print('compile_s', round(time.time() - t0, 2))
+t0 = time.time(); r = eng.run_config(keys); print('steady_s', round(time.time() - t0, 2))
+""",
+    # ET full config.
+    "et_full": """
+from probe_common import engine_and_keys
+eng, _ = engine_and_keys()
+import time
+keys = ('OD', 'Flake16', 'PCA', 'SMOTE Tomek', 'Extra Trees')
+t0 = time.time(); eng.run_config(keys); print('compile_s', round(time.time() - t0, 2))
+t0 = time.time(); r = eng.run_config(keys); print('steady_s', round(time.time() - t0, 2))
+""",
+    # Pallas Tree SHAP: one 25-tree slice, then the full chunked explain.
+    "shap": """
+from probe_common import shap_times
+for line in shap_times():
+    print(line)
+""",
+}
+
+
+def run_step(name, timeout):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.join(REPO, "tools") + ":" + env.get(
+        "PYTHONPATH", "")
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", STEP_SRC[name]], timeout=timeout,
+            capture_output=True, text=True, cwd=REPO, env=env,
+        )
+        out = {
+            "step": name, "ok": r.returncode == 0,
+            "wall_s": round(time.time() - t0, 2),
+            "out": r.stdout.strip().splitlines()[-8:],
+        }
+        if r.returncode != 0:
+            out["err"] = (r.stderr or "")[-400:]
+    except subprocess.TimeoutExpired:
+        out = {"step": name, "ok": False, "timeout_s": timeout,
+               "wall_s": round(time.time() - t0, 2)}
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as fd:
+        fd.write(json.dumps(out) + "\n")
+    print(json.dumps(out), flush=True)
+    return out["ok"]
+
+
+def main():
+    steps = sys.argv[1:] or ["matmul", "dt", "rf_chunk", "rf_full",
+                             "et_full", "shap"]
+    timeouts = {"matmul": 120, "dt": 420}
+    for name in steps:
+        ok = run_step(name, timeouts.get(name, 600))
+        if not ok:
+            print(f"step {name} failed — stopping (tunnel state unknown)",
+                  file=sys.stderr)
+            break
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Shared bodies for tools/hw_probe.py steps (imported inside the per-step
+subprocesses). Bench-sized data and engine, persistent compilation cache."""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(REPO, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+N_TESTS = int(os.environ.get("BENCH_N_TESTS", "2000"))
+N_TREES = int(os.environ.get("BENCH_N_TREES", "100"))
+DISPATCH = int(os.environ.get("BENCH_DISPATCH_TREES", "25"))
+
+
+def engine_and_keys():
+    import numpy as np
+
+    from flake16_framework_tpu.parallel.sweep import SweepEngine
+    from flake16_framework_tpu.utils.synth import make_dataset
+
+    feats, labels, pids = make_dataset(n_tests=N_TESTS, seed=7)
+    names = [f"project{p:02d}" for p in range(26)]
+    projects = np.array([names[p] for p in pids])
+    overrides = {"Random Forest": N_TREES, "Extra Trees": N_TREES}
+    eng = SweepEngine(feats, labels, projects, names, pids,
+                      tree_overrides=overrides, dispatch_trees=DISPATCH)
+    return eng, overrides
+
+
+def chunk_fit_times(config_keys):
+    """Time the prep dispatch and ONE tree-growth chunk dispatch separately
+    (compile vs steady), yielding printable lines."""
+    import jax.numpy as jnp
+
+    from flake16_framework_tpu import config as cfg
+
+    eng, _ = engine_and_keys()
+    fl_name, fs_name, prep_name, bal_name, model_name = config_keys
+    (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys), cols = \
+        eng._get_fns(fs_name, model_name)
+    x = jnp.asarray(eng.features[:, cols])
+    train_mask, _ = eng._masks[fl_name]
+    key = jax.random.PRNGKey(0)
+    args = (x, jnp.asarray(eng.labels_raw),
+            jnp.int32(cfg.FLAKY_TYPES[fl_name]),
+            jnp.int32(cfg.PREPROCESSINGS[prep_name]),
+            jnp.int32(cfg.BALANCINGS[bal_name]),
+            key, jnp.asarray(train_mask))
+
+    t0 = time.time()
+    prepped = cv_prep(*args)
+    jax.block_until_ready(prepped)
+    yield f"prep_compile_s {time.time() - t0:.2f}"
+    t0 = time.time()
+    prepped = cv_prep(*args)
+    jax.block_until_ready(prepped)
+    yield f"prep_steady_s {time.time() - t0:.2f}"
+    xs, ys, ws, edges, xp, y = prepped
+
+    tks = cv_tree_keys(key)
+    t0 = time.time()
+    f = cv_fit_chunk(xs, ys, ws, edges, tks[:, :DISPATCH])
+    jax.block_until_ready(f)
+    yield f"chunk_compile_s {time.time() - t0:.2f}"
+    t0 = time.time()
+    f = cv_fit_chunk(xs, ys, ws, edges, tks[:, DISPATCH:2 * DISPATCH])
+    jax.block_until_ready(f)
+    yield f"chunk_steady_s {time.time() - t0:.2f} ({DISPATCH} trees x {eng.n_folds} folds)"
+
+
+def shap_times():
+    """Pallas kernel: one tree-slice dispatch, then a full chunked explain."""
+    from flake16_framework_tpu import config as cfg, pipeline
+    from flake16_framework_tpu.utils.synth import make_dataset
+
+    feats, labels, _ = make_dataset(n_tests=N_TESTS, seed=7)
+    overrides = {"Random Forest": N_TREES, "Extra Trees": N_TREES}
+    keys = cfg.SHAP_CONFIGS[0]
+    kw = dict(tree_overrides=overrides, n_explain=512,
+              shap_tree_chunk=DISPATCH, fit_dispatch_trees=DISPATCH)
+    t0 = time.time()
+    pipeline.shap_for_config(keys, feats, labels, **kw)
+    yield f"shap_cfg0_compile_s {time.time() - t0:.2f}"
+    t0 = time.time()
+    pipeline.shap_for_config(keys, feats, labels, **kw)
+    yield f"shap_cfg0_steady_s {time.time() - t0:.2f}"
