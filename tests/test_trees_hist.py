@@ -161,3 +161,24 @@ def test_hist_matches_exact_grower_predictions_closely():
         np.asarray(predict(fh, x)) == np.asarray(predict(fe, x))
     )
     assert agree > 0.97, agree
+
+
+def test_hist_impl_formulations_agree_bitwise():
+    # The histogram grower has two trace-time formulations of its level
+    # step: one-hot matmuls (TPU/MXU) and segment-sum scatter-adds (CPU).
+    # Weights are small integers, so both accumulate exactly in f32 and the
+    # grown forests must be identical to the bit.
+    rng = np.random.RandomState(9)
+    n = 300
+    x = rng.randn(n, 16).astype(np.float32)
+    y = (x[:, 2] + 0.5 * rng.randn(n)) > 0
+    w = rng.randint(0, 3, n).astype(np.float32)  # integer bootstrap-ish
+    kw = dict(n_trees=6, bootstrap=True, random_splits=True,
+              sqrt_features=True, max_depth=12, max_nodes=600)
+    a = fit_forest_hist(x, y, w, jax.random.PRNGKey(4), hist_impl="segsum",
+                        **kw)
+    b = fit_forest_hist(x, y, w, jax.random.PRNGKey(4), hist_impl="einsum",
+                        **kw)
+    for f in a._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(a, f)),
+                                      np.asarray(getattr(b, f)), err_msg=f)

@@ -33,8 +33,8 @@ print('value', float((x @ x)[0, 0]))
 """,
     # Exact-grower DT family: compile + steady fit+score at bench size.
     "dt": """
-from probe_common import engine_and_keys
-eng, _ = engine_and_keys()
+from probe_common import make_engine
+eng = make_engine()
 import time
 keys = ('NOD', 'Flake16', 'None', 'None', 'Decision Tree')
 t0 = time.time(); eng.run_config(keys); print('compile_s', round(time.time() - t0, 2))
@@ -44,15 +44,15 @@ print('t_train_fold_s', round(r[0], 3))
     # Histogram-grower RF: ONE chunked tree-growth dispatch (25 trees x 10
     # folds) after prep, timed separately from its compile.
     "rf_chunk": """
-from probe_common import engine_and_keys, chunk_fit_times
+from probe_common import chunk_fit_times
 for line in chunk_fit_times(('NOD', 'Flake16', 'Scaling', 'SMOTE',
                              'Random Forest')):
     print(line)
 """,
     # Full RF config through run_config (all chunks + score).
     "rf_full": """
-from probe_common import engine_and_keys
-eng, _ = engine_and_keys()
+from probe_common import make_engine
+eng = make_engine()
 import time
 keys = ('NOD', 'Flake16', 'Scaling', 'SMOTE', 'Random Forest')
 t0 = time.time(); eng.run_config(keys); print('compile_s', round(time.time() - t0, 2))
@@ -60,8 +60,8 @@ t0 = time.time(); r = eng.run_config(keys); print('steady_s', round(time.time() 
 """,
     # ET full config.
     "et_full": """
-from probe_common import engine_and_keys
-eng, _ = engine_and_keys()
+from probe_common import make_engine
+eng = make_engine()
 import time
 keys = ('OD', 'Flake16', 'PCA', 'SMOTE Tomek', 'Extra Trees')
 t0 = time.time(); eng.run_config(keys); print('compile_s', round(time.time() - t0, 2))
@@ -106,6 +106,9 @@ def run_step(name, timeout):
 def main():
     steps = sys.argv[1:] or ["matmul", "dt", "rf_chunk", "rf_full",
                              "et_full", "shap"]
+    unknown = [s for s in steps if s not in STEP_SRC]
+    if unknown:
+        sys.exit(f"unknown step(s) {unknown}; known: {sorted(STEP_SRC)}")
     timeouts = {"matmul": 120, "dt": 420}
     for name in steps:
         ok = run_step(name, timeouts.get(name, 600))

@@ -10,28 +10,27 @@ sys.path.insert(0, REPO)
 
 import jax  # noqa: E402
 
+# Sizes, dataset, and cache config come from bench.py itself so the probe
+# measures (and pre-warms) exactly the bench's programs — no drift.
+import bench  # noqa: E402
+
 jax.config.update("jax_compilation_cache_dir",
                   os.path.join(REPO, ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-N_TESTS = int(os.environ.get("BENCH_N_TESTS", "2000"))
-N_TREES = int(os.environ.get("BENCH_N_TREES", "100"))
-DISPATCH = int(os.environ.get("BENCH_DISPATCH_TREES", "25"))
+N_TESTS = bench.N_TESTS
+N_TREES = bench.N_TREES
+DISPATCH = bench.DISPATCH_TREES
+N_EXPLAIN = min(bench.SHAP_EXPLAIN, N_TESTS)
 
 
-def engine_and_keys():
-    import numpy as np
-
+def make_engine():
     from flake16_framework_tpu.parallel.sweep import SweepEngine
-    from flake16_framework_tpu.utils.synth import make_dataset
 
-    feats, labels, pids = make_dataset(n_tests=N_TESTS, seed=7)
-    names = [f"project{p:02d}" for p in range(26)]
-    projects = np.array([names[p] for p in pids])
+    feats, labels, projects, names, pids = bench.make_data(N_TESTS)
     overrides = {"Random Forest": N_TREES, "Extra Trees": N_TREES}
-    eng = SweepEngine(feats, labels, projects, names, pids,
-                      tree_overrides=overrides, dispatch_trees=DISPATCH)
-    return eng, overrides
+    return SweepEngine(feats, labels, projects, names, pids,
+                       tree_overrides=overrides, dispatch_trees=DISPATCH)
 
 
 def chunk_fit_times(config_keys):
@@ -41,7 +40,7 @@ def chunk_fit_times(config_keys):
 
     from flake16_framework_tpu import config as cfg
 
-    eng, _ = engine_and_keys()
+    eng = make_engine()
     fl_name, fs_name, prep_name, bal_name, model_name = config_keys
     (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys), cols = \
         eng._get_fns(fs_name, model_name)
@@ -65,25 +64,29 @@ def chunk_fit_times(config_keys):
     xs, ys, ws, edges, xp, y = prepped
 
     tks = cv_tree_keys(key)
+    c = min(DISPATCH, N_TREES)
     t0 = time.time()
-    f = cv_fit_chunk(xs, ys, ws, edges, tks[:, :DISPATCH])
+    f = cv_fit_chunk(xs, ys, ws, edges, tks[:, :c])
     jax.block_until_ready(f)
     yield f"chunk_compile_s {time.time() - t0:.2f}"
+    # Steady-state: a SECOND slice of the same width when one exists (hits
+    # the jit cache), else re-dispatch the first slice.
+    lo = c if N_TREES >= 2 * c else 0
     t0 = time.time()
-    f = cv_fit_chunk(xs, ys, ws, edges, tks[:, DISPATCH:2 * DISPATCH])
+    f = cv_fit_chunk(xs, ys, ws, edges, tks[:, lo:lo + c])
     jax.block_until_ready(f)
-    yield f"chunk_steady_s {time.time() - t0:.2f} ({DISPATCH} trees x {eng.n_folds} folds)"
+    yield f"chunk_steady_s {time.time() - t0:.2f} ({c} trees x {eng.n_folds} folds)"
 
 
 def shap_times():
-    """Pallas kernel: one tree-slice dispatch, then a full chunked explain."""
+    """Pallas kernel: one tree-slice dispatch, then a full chunked explain
+    — same sizes as the bench worker's SHAP stage."""
     from flake16_framework_tpu import config as cfg, pipeline
-    from flake16_framework_tpu.utils.synth import make_dataset
 
-    feats, labels, _ = make_dataset(n_tests=N_TESTS, seed=7)
+    feats, labels, _, _, _ = bench.make_data(N_TESTS)
     overrides = {"Random Forest": N_TREES, "Extra Trees": N_TREES}
     keys = cfg.SHAP_CONFIGS[0]
-    kw = dict(tree_overrides=overrides, n_explain=512,
+    kw = dict(tree_overrides=overrides, n_explain=N_EXPLAIN,
               shap_tree_chunk=DISPATCH, fit_dispatch_trees=DISPATCH)
     t0 = time.time()
     pipeline.shap_for_config(keys, feats, labels, **kw)
