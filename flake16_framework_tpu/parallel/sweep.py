@@ -186,18 +186,23 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
     production sweep path (the reference forks a process per config,
     experiment.py:493-498; here a batch of configs is one SPMD program).
 
-    Returns (fit_b, score_b):
+    Returns (fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b):
       fit_b(x, y_raw, fls [B], preps [B], bals [B], keys [B,2],
             train_masks [B,folds,N]) -> (forest [B,folds,...], xp [B,N,F'],
             y [B,N]) — all sharded over "config", left on device.
       score_b(forest, xp, y, test_masks [B,folds,N], project_ids)
             -> counts [B,P,3].
-    Two stages (not one fused call) so the reference's per-config
-    T_TRAIN/T_TEST split (experiment.py:468-474) stays measurable, like
-    ``make_cv_fns``. B must be a multiple of the mesh "config" axis size;
-    within a shard, configs ride a vmap axis.
+      prep_b (same args as fit_b) -> (xs, ys, ws, edges, xp, y) and
+      fit_chunk_b(xs, ys, ws, edges, tks [B,folds,c,2]) -> forest chunk:
+      the dispatch-bounded twin of fit_b (SweepEngine dispatch_trees),
+      with tree_keys_b(keys [B,2]) -> [B,folds,T,2] supplying the table.
+    Fit and score are separate calls (not one fused program) so the
+    reference's per-config T_TRAIN/T_TEST split (experiment.py:468-474)
+    stays measurable, like ``make_cv_fns``. B must be a multiple of the
+    mesh "config" axis size; within a shard, configs ride a vmap axis.
     """
-    fit_one, score_one, *_ = _make_config_fns(
+    (fit_one, score_one, prep_resample_one, fit_trees_chunk,
+     tree_keys_one) = _make_config_fns(
         spec, n=n, n_projects=n_projects, max_depth=max_depth,
         n_folds=n_folds, tree_chunk=tree_chunk,
     )
@@ -209,6 +214,19 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
             )
         )(fls, preps, bals, keys, train_masks)
 
+    def prep_batch(x, y_raw, fls, preps, bals, keys, train_masks):
+        return jax.vmap(
+            lambda fl, prep, bal, key, trm: prep_resample_one(
+                x, y_raw, fl, prep, bal, key, trm
+            )
+        )(fls, preps, bals, keys, train_masks)
+
+    def fit_chunk_batch(xs, ys, ws, edges, tks):
+        return jax.vmap(fit_trees_chunk)(xs, ys, ws, edges, tks)
+
+    def tree_keys_batch(keys):
+        return jax.vmap(tree_keys_one)(keys)
+
     def score_batch(forest, xp, y, test_masks, project_ids):
         return jax.vmap(
             lambda f, xpi, yi, tem: score_one(f, xpi, yi, tem, project_ids)
@@ -218,26 +236,46 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
     forest_specs = jax.tree.map(lambda _: pspec, trees.Forest(
         *[0] * len(trees.Forest._fields)
     ))
-    fit_b = jax.jit(
-        jax.shard_map(
-            fit_batch, mesh=mesh,
-            in_specs=(P(), P(), pspec, pspec, pspec, pspec, pspec),
-            out_specs=(forest_specs, pspec, pspec),
-            # Replicated data arrays mix with config-varying codes inside
-            # lax.switch; jax 0.9's varying-manual-axes validator rejects
-            # that conservatively (its own error message says to disable).
-            check_vma=False,
-        )
-    )
-    score_b = jax.jit(
-        jax.shard_map(
-            score_batch, mesh=mesh,
-            in_specs=(forest_specs, pspec, pspec, pspec, P()),
-            out_specs=pspec,
-            check_vma=False,
-        )
-    )
-    return fit_b, score_b
+    # Replicated data arrays mix with config-varying codes inside
+    # lax.switch; jax 0.9's varying-manual-axes validator rejects
+    # that conservatively (its own error message says to disable).
+    def smap(f, in_specs, out_specs):
+        return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                     out_specs=out_specs, check_vma=False))
+
+    fit_b = smap(fit_batch, (P(), P(), pspec, pspec, pspec, pspec, pspec),
+                 (forest_specs, pspec, pspec))
+    prep_b = smap(prep_batch, (P(), P(), pspec, pspec, pspec, pspec, pspec),
+                  (pspec, pspec, pspec, pspec, pspec, pspec))
+    fit_chunk_b = smap(fit_chunk_batch,
+                       (pspec, pspec, pspec, pspec, pspec), forest_specs)
+    tree_keys_b = smap(tree_keys_batch, (pspec,), pspec)
+    score_b = smap(score_batch, (forest_specs, pspec, pspec, pspec, P()),
+                   pspec)
+    return fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b
+
+
+def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
+                 dc, *, tree_axis):
+    """The dispatch-chunked fit protocol, shared by the single-device and
+    mesh-batched paths: one prep+resample dispatch, then ceil(T/dc)
+    bounded-duration tree-growth dispatches (each blocked — PROFILE.md fault
+    envelope), forests concatenated on ``tree_axis``. Bit-identical to the
+    corresponding single-dispatch fit: both read the same per-tree key
+    table. Returns (forest, xp, y) with the forest fully materialized, so
+    callers' t_train clocks include the concat."""
+    xs, ys, ws, edges, xp, y = prep_fn(*fit_args)
+    tks = tree_keys_thunk()
+    sl = (slice(None),) * tree_axis
+    parts = []
+    for lo in range(0, n_trees, dc):
+        forest_c = fit_chunk_fn(xs, ys, ws, edges,
+                                tks[sl + (slice(lo, lo + dc),)])
+        jax.block_until_ready(forest_c)
+        parts.append(forest_c)
+    forest = trees.concat_trees(parts, axis=tree_axis)
+    jax.block_until_ready(forest)
+    return forest, xp, y
 
 
 class SweepEngine:
@@ -344,19 +382,10 @@ class SweepEngine:
 
         t0 = time.time()
         if dc is not None and n_trees > dc:
-            # Dispatch-chunked fit: one prep+resample dispatch, then
-            # ceil(T/dc) bounded-duration tree-growth dispatches; forests
-            # concatenated on the tree axis (bit-identical to the
-            # single-dispatch path — the key table is shared).
-            xs, ys, ws, edges, xp, y = cv_prep(*fit_args)
-            tks = cv_tree_keys(key)
-            parts = []
-            for lo in range(0, n_trees, dc):
-                forest_c = cv_fit_chunk(xs, ys, ws, edges,
-                                        tks[:, lo:lo + dc])
-                jax.block_until_ready(forest_c)
-                parts.append(forest_c)
-            forest = trees.concat_trees(parts, axis=1)
+            forest, xp, y = _chunked_fit(
+                cv_prep, cv_fit_chunk, lambda: cv_tree_keys(key), fit_args,
+                n_trees, dc, tree_axis=1,
+            )
         else:
             forest, xp, y = cv_fit(*fit_args)
             jax.block_until_ready(forest)
@@ -400,7 +429,8 @@ class SweepEngine:
         fs_name, model_name = config_batch[0][1], config_batch[0][4]
         assert all(k[1] == fs_name and k[4] == model_name
                    for k in config_batch)
-        (fit_b, score_b), cols = self._get_sharded_fns(fs_name, model_name)
+        (fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b), cols = \
+            self._get_sharded_fns(fs_name, model_name)
 
         d = self.mesh.devices.size
         pad = (-len(config_batch)) % d
@@ -420,13 +450,25 @@ class SweepEngine:
         tems = np.stack([self._masks[k[0]][1] for k in batch])
 
         x = jnp.asarray(self.features[:, cols])
-        t0 = time.time()
-        forest, xp, y = fit_b(
+        fit_args = (
             x, jnp.asarray(self.labels_raw), jnp.asarray(fls),
             jnp.asarray(preps), jnp.asarray(bals), jnp.asarray(keys),
             jnp.asarray(trms),
         )
-        jax.block_until_ready(forest)
+        n_trees = self._spec(model_name).n_trees
+        dc = self.dispatch_trees
+
+        t0 = time.time()
+        if dc is not None and n_trees > dc:
+            # Same dispatch-bounding as run_config, but SPMD over the mesh:
+            # every chunk dispatch is one shard_map program.
+            forest, xp, y = _chunked_fit(
+                prep_b, fit_chunk_b, lambda: tree_keys_b(jnp.asarray(keys)),
+                fit_args, n_trees, dc, tree_axis=2,
+            )
+        else:
+            forest, xp, y = fit_b(*fit_args)
+            jax.block_until_ready(forest)
         t_train = (time.time() - t0) / b
 
         t0 = time.time()

@@ -126,7 +126,7 @@ def test_sharded_cv_fns_match_single_device(engine):
     spec = engine._spec("Decision Tree")
     n, nf = engine.features.shape
 
-    fit_b, score_b = sweep.make_sharded_cv_fns(
+    fit_b, score_b, *_ = sweep.make_sharded_cv_fns(
         spec, mesh, n=n, n_feat=nf, n_projects=len(engine.project_names),
         max_depth=24,
     )
@@ -177,3 +177,28 @@ def test_dispatch_chunked_fit_matches_single_dispatch(engine):
         b = chunked.run_config(keys)
         assert a[3] == b[3], keys  # scores_total identical
         assert a[2] == b[2], keys  # per-project scores identical
+
+
+def test_sharded_dispatch_chunked_matches_unchunked():
+    # The mesh-batched chunked fit (run_config_batch under dispatch_trees)
+    # must reproduce the unchunked sharded path exactly — both paths read
+    # the same per-tree key table, just in different dispatch groupings.
+    feats, labels, pids = make_dataset(n_tests=160, n_projects=5, seed=13)
+    names = [f"project{p:02d}" for p in range(5)]
+    projects = np.array([names[p] for p in pids])
+    common = dict(max_depth=16, tree_overrides={"Random Forest": 6})
+    base = sweep.SweepEngine(feats, labels, projects, names, pids,
+                             mesh=sweep.default_mesh(), **common)
+    chunked = sweep.SweepEngine(feats, labels, projects, names, pids,
+                                mesh=sweep.default_mesh(),
+                                dispatch_trees=4, **common)  # 6 -> 4+2
+    configs = [
+        ("NOD", "Flake16", p, b, "Random Forest")
+        for p, b in [("None", "None"), ("Scaling", "SMOTE"),
+                     ("PCA", "ENN"), ("None", "SMOTE Tomek")]
+    ]
+    a = base.run_grid(configs)
+    b = chunked.run_grid(configs)
+    for keys in configs:
+        assert a[keys][3] == b[keys][3], keys
+        assert a[keys][2] == b[keys][2], keys
