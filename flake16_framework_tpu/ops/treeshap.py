@@ -29,7 +29,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from flake16_framework_tpu.ops.trees import slice_trees
+from flake16_framework_tpu.ops.trees import slice_trees, trim_nodes
 
 
 def extract_paths(feature, threshold, left, right, value, max_depth):
@@ -214,7 +214,7 @@ def tree_shap_single(paths, x, n_features):
 
 
 def forest_shap_class0(forest, x, *, sample_chunk=None, impl="auto",
-                       tree_chunk=None):
+                       tree_chunk=None, _trim=True):
     """Mean over trees of per-tree class-0 Tree SHAP — the ensemble
     soft-vote's probability decomposition (what shap_values(X)[0] returns for
     a sklearn forest).
@@ -239,6 +239,19 @@ def forest_shap_class0(forest, x, *, sample_chunk=None, impl="auto",
     repeated explains (the 2 reference configs, the bench's steady-state
     timing) reuse one compiled program instead of re-lowering per call.
     """
+    # Trim node-slot padding before anything else: the per-(leaf, sample)
+    # workspace scales with M//2+1 leaf SLOTS, and fit-time max_nodes is a
+    # worst-case bound typically several times the grown size. One host
+    # sync of max(n_nodes), rounded up to keep the jit cache small; ONLY at
+    # the top level — per-chunk re-trims would give chunks different M
+    # buckets and recompile the SHAP program per chunk.
+    if _trim:
+        m = forest.feature.shape[-1]
+        n_used = int(jax.device_get(jnp.max(forest.n_nodes)))
+        m_trim = min(m, max(128, -(-n_used // 128) * 128))
+        if m_trim < m:
+            forest = trim_nodes(forest, m_trim)
+
     t_total = forest.feature.shape[0]
     if tree_chunk is not None and tree_chunk < t_total:
         acc = None
@@ -246,7 +259,7 @@ def forest_shap_class0(forest, x, *, sample_chunk=None, impl="auto",
             sub = slice_trees(forest, lo, lo + tree_chunk)
             c = sub.feature.shape[0]
             phi = forest_shap_class0(sub, x, sample_chunk=sample_chunk,
-                                     impl=impl) * c
+                                     impl=impl, _trim=False) * c
             phi.block_until_ready()
             acc = phi if acc is None else acc + phi
         return acc / t_total
