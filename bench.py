@@ -222,10 +222,14 @@ def worker(n_tests, n_trees):
 
     t0 = time.time()
     t_fit = t_pred = 0.0
+    per_config = {}
     for keys in CONFIGS:
         res = engine.run_config(keys)
         t_fit += res[0] * engine.n_folds
         t_pred += res[1] * engine.n_folds
+        per_config["/".join(keys)] = round(
+            (res[0] + res[1]) * engine.n_folds, 3
+        )
     t_scores = time.time() - t0
 
     # SHAP stage (auto impl: the Pallas kernel on TPU, XLA elsewhere).
@@ -244,6 +248,8 @@ def worker(n_tests, n_trees):
     print(json.dumps({
         "t_scores": round(t_scores, 3), "t_shap": round(t_shap, 3),
         "t_fit": round(t_fit, 3), "t_predict": round(t_pred, 3),
+        "per_config_s": per_config,
+        "dispatch_trees": DISPATCH_TREES,
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -350,6 +356,8 @@ def main():
         t_ours_scores_s=result["t_scores"], t_ours_shap_s=result["t_shap"],
         t_ours_fit_s=result.get("t_fit"),
         t_ours_predict_s=result.get("t_predict"),
+        per_config_s=result.get("per_config_s"),
+        dispatch_trees=result.get("dispatch_trees"),
         scores_speedup=round(sum(t_base_scores) / result["t_scores"], 3)
         if result["t_scores"] else None,
         shap_speedup=round(sum(t_base_shap) / result["t_shap"], 3)
