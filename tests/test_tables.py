@@ -1,0 +1,23 @@
+"""Figure-table numerics: the scipy-free Spearman vs scipy itself (the
+reference uses scipy.stats.spearmanr at experiment.py:661; scipy is present
+in this environment only as a transitive dependency, so the figures path
+must not import it — but the test may)."""
+
+import numpy as np
+import pytest
+from scipy import stats
+
+from flake16_framework_tpu.figures.tables import spearman_matrix
+
+
+@pytest.mark.parametrize("seed,ties", [(0, False), (1, True)])
+def test_spearman_matches_scipy(seed, ties):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(120, 6)
+    if ties:
+        # heavy ties: integer-quantized columns plus a constant-ish column
+        x[:, :3] = np.round(x[:, :3])
+        x[:, 3] = np.repeat(rng.randn(12), 10)
+    ours = spearman_matrix(x)
+    ref = stats.spearmanr(x).statistic
+    np.testing.assert_allclose(ours, ref, rtol=1e-12, atol=1e-12)

@@ -73,6 +73,15 @@ from probe_common import shap_times
 for line in shap_times():
     print(line)
 """,
+    # Hardware-mode kernel equality: the Pallas kernel compiled FOR THE
+    # DEVICE (not the interpreter the CPU tests use) must match the XLA
+    # formulation on the same forest (VERDICT r1: interpret-mode equality
+    # is necessary, not sufficient — tiling/dynamic indexing diverge on
+    # silicon).
+    "shap_equiv": """
+from probe_common import shap_hw_equality
+print(shap_hw_equality())
+""",
 }
 
 
@@ -105,7 +114,7 @@ def run_step(name, timeout):
 
 def main():
     steps = sys.argv[1:] or ["matmul", "dt", "rf_chunk", "rf_full",
-                             "et_full", "shap"]
+                             "et_full", "shap", "shap_equiv"]
     unknown = [s for s in steps if s not in STEP_SRC]
     if unknown:
         sys.exit(f"unknown step(s) {unknown}; known: {sorted(STEP_SRC)}")

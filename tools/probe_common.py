@@ -94,3 +94,38 @@ def shap_times():
     t0 = time.time()
     pipeline.shap_for_config(keys, feats, labels, **kw)
     yield f"shap_cfg0_steady_s {time.time() - t0:.2f}"
+
+
+def shap_hw_equality():
+    """Pallas kernel on the REAL device vs the XLA formulation, mixed small
+    forest (bootstrap weights, sub-lane feature count path not exercised —
+    bench width 16). Returns a max-abs-diff line; raises if out of
+    tolerance."""
+    import numpy as np
+
+    from flake16_framework_tpu.ops.trees import fit_forest
+    from flake16_framework_tpu.ops.treeshap import forest_shap_class0
+
+    rng = np.random.RandomState(11)
+    n = 160
+    x = rng.randn(n, 16).astype(np.float32)
+    y = (x[:, 1] - x[:, 2] + 0.5 * rng.randn(n)) > 0
+    forest = fit_forest(
+        x, y, np.ones(n, np.float32), jax.random.PRNGKey(3), n_trees=8,
+        bootstrap=True, random_splits=True, sqrt_features=True, max_depth=9,
+        max_nodes=512,
+    )
+    if jax.default_backend() != "tpu":
+        # interpret-mode equality is already a CPU pytest; this step exists
+        # only for the real kernel — a silent interpreter pass would defeat it
+        raise RuntimeError(
+            f"shap_equiv needs the TPU backend, got {jax.default_backend()}"
+        )
+    xq = rng.randn(70, 16).astype(np.float32)
+    a = np.asarray(forest_shap_class0(forest, xq, impl="pallas"))
+    b = np.asarray(forest_shap_class0(forest, xq, impl="xla"))
+    d = float(np.abs(a - b).max())
+    rel = d / max(float(np.abs(b).max()), 1e-12)
+    if rel >= 1e-3:  # not a bare assert: must survive PYTHONOPTIMIZE
+        raise AssertionError(f"pallas-vs-xla on device: rel={rel}")
+    return f"pallas_vs_xla_maxabs {d:.3e} rel {rel:.3e} OK"
