@@ -20,13 +20,12 @@ Fit and predict run as two jitted stages so the reference's per-config
 T_TRAIN/T_TEST timing fields (experiment.py:468-474) stay measurable.
 """
 
-import functools
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from flake16_framework_tpu import config as cfg
 from flake16_framework_tpu.ops.metrics import confusion_by_project, format_scores
