@@ -144,6 +144,10 @@ def ours_config_f1s(feats, labels, pids, keys, *, n_trees, seeds):
     engine = SweepEngine(
         feats, labels, projects, names, pids,
         tree_overrides={"Random Forest": n_trees, "Extra Trees": n_trees},
+        # Bounded dispatches (same default as bench.py): the full tier runs
+        # 100-tree x 10-fold fits on the TPU tunnel, which faults on
+        # multi-minute single dispatches (PROFILE.md).
+        dispatch_trees=int(os.environ.get("BENCH_DISPATCH_TREES", "25")),
     )
     out = []
     for s in seeds:
