@@ -1,0 +1,47 @@
+"""bench.py is the driver-facing artifact: its last stdout line must always
+be one JSON object with the contract fields, whatever the device does.
+Runs the real script in a subprocess at tiny size on the CPU backend (the
+TPU path is exercised by the driver itself; tools/hw_probe.py measures it
+per stage)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(extra_env):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=900, cwd=REPO, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-500:]
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_bench_json_contract_cpu_fallback():
+    out = _run_bench({
+        "BENCH_DEVICE": "cpu",           # operator opt-out of the TPU probe
+        "BENCH_FB_N_TESTS": "120",
+        "BENCH_FB_N_TREES": "3",
+        "BENCH_SHAP_EXPLAIN": "24",
+        "BENCH_DISPATCH_TREES": "2",
+        "BENCH_WORKER_TIMEOUT_S": "600",
+    })
+    # The driver's contract: one JSON line with these fields.
+    assert set(out) >= {"metric", "value", "unit", "vs_baseline", "detail"}
+    assert out["unit"] == "x_vs_single_host_cpu_stack"
+    assert out["value"] > 0, out  # CPU fallback must still produce a number
+    d = out["detail"]
+    assert d["backend"] == "cpu"
+    assert d["tpu_probe"] == "disabled"
+    # Every probe config has an end-to-end time (all three model families).
+    assert len(d["per_config_s"]) == 6
+    assert all(v > 0 for v in d["per_config_s"].values())
+    assert d["t_ours_shap_s"] > 0 and d["t_cpu_shap_s"] > 0
