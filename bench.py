@@ -197,9 +197,12 @@ def worker(n_tests, n_trees):
     # Persistent compilation cache: the measurement is steady-state (compile
     # excluded by design), so letting retries and repeat bench runs skip the
     # multi-family warm-up compiles only removes dead time from the budget.
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(REPO, ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # TPU-backend only: XLA:CPU AOT cache entries reload with host-feature
+    # mismatch warnings ("could lead to ... SIGILL") on this VM.
+    if jax.default_backend() != "cpu":
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     from flake16_framework_tpu import config as cfg, pipeline
     from flake16_framework_tpu.parallel.sweep import SweepEngine

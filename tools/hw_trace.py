@@ -1,0 +1,127 @@
+"""Capture a jax.profiler trace of one bench-sized chunk fit (and optionally
+the SHAP explain) and summarize device-op time by source operation.
+
+Usage:
+    python tools/hw_trace.py fit          # one RF tree-growth chunk dispatch
+    python tools/hw_trace.py shap         # one SHAP config explain
+    python tools/hw_trace.py fit shap
+
+Writes the raw trace under _scratch/trace_<step>/ and prints the top device
+ops by total duration (parsed from the perfetto .trace.json.gz), mapped back
+to HLO metadata where present. This is the committed form of the scratch
+script behind PROFILE.md's round-2 findings.
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def summarize(trace_dir, top=25):
+    """Sum device-track slice durations by op name from the newest perfetto
+    trace under ``trace_dir``."""
+    paths = sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True,
+    ), key=os.path.getmtime)
+    if not paths:
+        print(f"no trace found under {trace_dir}")
+        return
+    with gzip.open(paths[-1], "rt") as fd:
+        data = json.load(fd)
+    events = data.get("traceEvents", [])
+    # device tracks: process names containing "TPU" / "Device"
+    pid_name = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_name[e["pid"]] = e["args"].get("name", "")
+    dur_by_name = defaultdict(float)
+    count_by_name = defaultdict(int)
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        pname = pid_name.get(e.get("pid"), "")
+        if not ("TPU" in pname or "Device" in pname or "/device" in pname):
+            continue
+        d = float(e.get("dur", 0.0))
+        name = e.get("name", "?")
+        dur_by_name[name] += d
+        count_by_name[name] += 1
+        total += d
+    print(f"trace: {paths[-1]}")
+    print(f"device total: {total / 1e6:.3f} s over "
+          f"{sum(count_by_name.values())} slices")
+    for name, d in sorted(dur_by_name.items(), key=lambda kv: -kv[1])[:top]:
+        print(f"{d / 1e6:9.3f} s  x{count_by_name[name]:<5d} {name[:100]}")
+
+
+def trace_fit():
+    import jax
+
+    from probe_common import make_engine, DISPATCH
+    from flake16_framework_tpu import config as cfg
+    import jax.numpy as jnp
+
+    eng = make_engine()
+    keys5 = ("NOD", "Flake16", "Scaling", "SMOTE", "Random Forest")
+    fl_name, fs_name, prep_name, bal_name, model_name = keys5
+    (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys), cols = \
+        eng._get_fns(fs_name, model_name)
+    x = jnp.asarray(eng.features[:, cols])
+    train_mask, _ = eng._masks[fl_name]
+    key = jax.random.PRNGKey(0)
+    args = (x, jnp.asarray(eng.labels_raw),
+            jnp.int32(cfg.FLAKY_TYPES[fl_name]),
+            jnp.int32(cfg.PREPROCESSINGS[prep_name]),
+            jnp.int32(cfg.BALANCINGS[bal_name]),
+            key, jnp.asarray(train_mask))
+    prepped = cv_prep(*args)
+    jax.block_until_ready(prepped)
+    xs, ys, ws, edges, xp, y = prepped
+    tks = cv_tree_keys(key)
+    c = min(DISPATCH, tks.shape[1])
+    # warm the compile outside the trace
+    jax.block_until_ready(cv_fit_chunk(xs, ys, ws, edges, tks[:, :c]))
+    out_dir = os.path.join(REPO, "_scratch", "trace_fit")
+    with jax.profiler.trace(out_dir):
+        jax.block_until_ready(cv_fit_chunk(xs, ys, ws, edges, tks[:, :c]))
+    summarize(out_dir)
+
+
+def trace_shap():
+    import jax
+
+    import bench
+    from probe_common import DISPATCH, N_EXPLAIN, N_TESTS, N_TREES
+    from flake16_framework_tpu import config as cfg, pipeline
+
+    feats, labels, _, _, _ = bench.make_data(N_TESTS)
+    overrides = {"Random Forest": N_TREES, "Extra Trees": N_TREES}
+    kw = dict(tree_overrides=overrides, n_explain=N_EXPLAIN,
+              shap_tree_chunk=DISPATCH, fit_dispatch_trees=DISPATCH)
+    keys = cfg.SHAP_CONFIGS[0]
+    pipeline.shap_for_config(keys, feats, labels, **kw)  # warm
+    out_dir = os.path.join(REPO, "_scratch", "trace_shap")
+    with jax.profiler.trace(out_dir):
+        pipeline.shap_for_config(keys, feats, labels, **kw)
+    summarize(out_dir)
+
+
+def main():
+    steps = sys.argv[1:] or ["fit"]
+    unknown = [s for s in steps if s not in ("fit", "shap")]
+    if unknown:
+        sys.exit(f"unknown step(s) {unknown}; known: fit, shap")
+    for s in steps:
+        (trace_fit if s == "fit" else trace_shap)()
+
+
+if __name__ == "__main__":
+    main()
