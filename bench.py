@@ -189,20 +189,29 @@ def cpu_shap_baseline(feats, labels_raw, n_trees):
     return times
 
 
+def configure_jax_cache():
+    """Enable the persistent compilation cache on accelerator backends.
+
+    The measurement is steady-state (compile excluded by design), so letting
+    retries and repeat bench runs skip the multi-family warm-up compiles only
+    removes dead time from the budget. TPU-backend only: XLA:CPU AOT cache
+    entries reload with host-feature mismatch warnings ("could lead to ...
+    SIGILL") on this VM. Shared with tools/probe_common.py so the probe
+    provably pre-warms the bench's own cache."""
+    import jax
+
+    if jax.default_backend() != "cpu":
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
 def worker(n_tests, n_trees):
     """Subprocess body: run the jitted scores probe + the 2 SHAP configs on
     the default backend; print one JSON line with steady-state timings."""
     import jax
 
-    # Persistent compilation cache: the measurement is steady-state (compile
-    # excluded by design), so letting retries and repeat bench runs skip the
-    # multi-family warm-up compiles only removes dead time from the budget.
-    # TPU-backend only: XLA:CPU AOT cache entries reload with host-feature
-    # mismatch warnings ("could lead to ... SIGILL") on this VM.
-    if jax.default_backend() != "cpu":
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(REPO, ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    configure_jax_cache()
 
     from flake16_framework_tpu import config as cfg, pipeline
     from flake16_framework_tpu.parallel.sweep import SweepEngine

@@ -844,15 +844,34 @@ def fit_forest(x, y, w, key, *, n_trees, bootstrap, random_splits,
                   jnp.int32(max_depth))
 
 
-@jax.jit
-def predict_proba(forest, x):
+# Window width of the gather-free predict sweep (lane-dim friendly).
+PREDICT_WINDOW = 128
+
+
+@functools.partial(jax.jit, static_argnames=("impl",))
+def predict_proba(forest, x, impl=None):
     """Mean of per-tree leaf class distributions (sklearn soft vote:
     ensemble predict_proba averages per-tree normalized leaf counts).
-    Traversal length comes from the forest's own fit-time depth bound."""
+
+    Two traversal formulations, chosen by backend at trace time (``impl``
+    overrides: "gather"/"windows"):
+
+    - "gather" — classic per-level node-table lookups; fast on CPU, but
+      TPUs serialize gathers (~70 M elem/s measured, PROFILE.md), making
+      5*S*depth*instances lookups the predict bottleneck at bench sizes.
+    - "windows" — sweep fixed node-id windows [k*W, (k+1)*W): per window,
+      one [S,F]@[F,W] one-hot feature-select matmul + comparison table,
+      then an inner loop routes resident samples (re-entered while any
+      sample can still descend inside the window — node ids are monotone
+      parent->child for both growers, so a forward sweep visits every
+      path). No per-sample gathers except the final leaf-value read.
+    """
+    if impl is None:
+        impl = "gather" if jax.default_backend() == "cpu" else "windows"
     s = x.shape[0]
     depth = jnp.max(forest.max_depth)  # scalar even if forests were stacked
 
-    def one(feature, threshold, left, right, value):
+    def one_gather(feature, threshold, left, right, value):
         def step(_, node):
             f = feature[node]
             leaf = f < 0
@@ -861,11 +880,94 @@ def predict_proba(forest, x):
             return jnp.where(leaf, node, nxt)
 
         node = lax.fori_loop(0, depth + 1, step, jnp.zeros(s, jnp.int32))
-        v = value[node]
-        return v / jnp.maximum(v.sum(-1, keepdims=True), 1e-30)
+        return node
 
+    def one_windows(feature, threshold, left, right, value, n_nodes):
+        m = feature.shape[0]
+        bw = min(PREDICT_WINDOW, m)
+        # Pad node tables to a window multiple: dynamic_slice CLAMPS an
+        # out-of-range start, which would silently misalign the final
+        # partial window (rel uses the unclamped lo). Padding is leaf-like
+        # (-1 feature) so no sample can route through it.
+        pad = (-m) % bw
+        if pad:
+            feature = jnp.concatenate(
+                [feature, jnp.full((pad,), -1, feature.dtype)])
+            threshold = jnp.concatenate(
+                [threshold, jnp.zeros((pad,), threshold.dtype)])
+            left = jnp.concatenate([left, jnp.full((pad,), -1, left.dtype)])
+            right = jnp.concatenate(
+                [right, jnp.full((pad,), -1, right.dtype)])
+        n_feat = x.shape[1]
+        iota = jnp.arange(bw, dtype=jnp.int32)
+
+        def routing_state(node, lo, leafw):
+            rel = node - lo
+            in_w = (rel >= 0) & (rel < bw)
+            oh = (rel[:, None] == iota[None, :]) & in_w[:, None]
+            at_leaf = jnp.sum(oh & leafw[None, :], axis=1) > 0
+            return oh, in_w & ~at_leaf
+
+        def window(state):
+            k, node = state
+            lo = k * bw
+            featw = lax.dynamic_slice(feature, (lo,), (bw,))
+            thrw = lax.dynamic_slice(threshold, (lo,), (bw,))
+            leftw = lax.dynamic_slice(left, (lo,), (bw,))
+            rightw = lax.dynamic_slice(right, (lo,), (bw,))
+            leafw = featw < 0
+            fsel = jax.nn.one_hot(featw, n_feat, dtype=x.dtype)  # [W, F]
+            # HIGHEST precision: default TPU matmul rounds through bf16,
+            # and thresholds are exact midpoints of these same values —
+            # the one boundary-sensitive comparison in the whole traversal.
+            xsel = jnp.matmul(x, fsel.T,
+                              precision=lax.Precision.HIGHEST)   # [S, W]
+            nxtw = jnp.where(xsel <= thrw[None, :], leftw[None, :],
+                             rightw[None, :])                    # [S, W]
+
+            def route(inner):
+                node, oh, movable = inner
+                nxt = jnp.sum(jnp.where(oh, nxtw, 0), axis=1)
+                node = jnp.where(movable, nxt, node).astype(jnp.int32)
+                oh, movable = routing_state(node, lo, leafw)
+                return node, oh, movable
+
+            def route_cond(inner):
+                return jnp.any(inner[2])
+
+            oh0, movable0 = routing_state(node, lo, leafw)
+            node, _, _ = lax.while_loop(route_cond, route,
+                                        (node, oh0, movable0))
+            return k + 1, node
+
+        def cond(state):
+            k, _ = state
+            return k * bw < n_nodes
+
+        _, node = lax.while_loop(
+            cond, window, (jnp.int32(0), jnp.zeros(s, jnp.int32))
+        )
+        return node
+
+    if impl == "gather":
+        def one(feature, threshold, left, right, value, n_nodes):
+            node = one_gather(feature, threshold, left, right, value)
+            v = value[node]
+            return v / jnp.maximum(v.sum(-1, keepdims=True), 1e-30)
+    elif impl == "windows":
+        def one(feature, threshold, left, right, value, n_nodes):
+            node = one_windows(feature, threshold, left, right, value,
+                               n_nodes)
+            v = value[node]
+            return v / jnp.maximum(v.sum(-1, keepdims=True), 1e-30)
+    else:
+        raise ValueError(f"unknown predict impl {impl!r}")
+
+    n_nodes_per_tree = jnp.max(
+        forest.n_nodes.reshape(forest.feature.shape[0], -1), axis=-1
+    ).astype(jnp.int32)
     probs = jax.vmap(one)(forest.feature, forest.threshold, forest.left,
-                          forest.right, forest.value)
+                          forest.right, forest.value, n_nodes_per_tree)
     return jnp.mean(probs, axis=0)
 
 

@@ -182,3 +182,28 @@ def test_hist_impl_formulations_agree_bitwise():
     for f in a._fields:
         np.testing.assert_array_equal(np.asarray(getattr(a, f)),
                                       np.asarray(getattr(b, f)), err_msg=f)
+
+
+def test_predict_windows_matches_gather():
+    # The gather-free window-routing predict (TPU formulation) must agree
+    # with the classic gather traversal for forests from BOTH growers
+    # (monotone parent->child node ids is the only invariant it needs).
+    from flake16_framework_tpu.ops.trees import fit_forest, predict_proba
+
+    rng = np.random.RandomState(17)
+    n = 250
+    x = rng.randn(n, 16).astype(np.float32)
+    y = (x[:, 1] + 0.4 * rng.randn(n)) > 0
+    w = np.ones(n, np.float32)
+    xq = rng.randn(90, 16).astype(np.float32)
+    # max_nodes=200: NOT a multiple of the 128-wide predict window, and
+    # deep bootstrap trees exceed 128 nodes — forces the padded final
+    # partial window (where an unpadded dynamic_slice would misalign).
+    kw = dict(n_trees=5, bootstrap=True, random_splits=True,
+              sqrt_features=True, max_depth=16, max_nodes=200)
+    for fit in (fit_forest_hist, fit_forest):
+        forest = fit(x, y, w, jax.random.PRNGKey(6), **kw)
+        assert int(np.max(np.asarray(forest.n_nodes))) > 128  # crosses win 2
+        a = np.asarray(predict_proba(forest, xq, impl="gather"))
+        b = np.asarray(predict_proba(forest, xq, impl="windows"))
+        np.testing.assert_array_equal(a, b, err_msg=str(fit))

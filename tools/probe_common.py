@@ -14,12 +14,7 @@ import jax  # noqa: E402
 # measures (and pre-warms) exactly the bench's programs — no drift.
 import bench  # noqa: E402
 
-# TPU-backend only — XLA:CPU AOT cache entries reload with host-feature
-# mismatch warnings ("could lead to ... SIGILL") on this VM (see bench.py).
-if jax.default_backend() != "cpu":
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(REPO, ".jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+bench.configure_jax_cache()
 
 N_TESTS = bench.N_TESTS
 N_TREES = bench.N_TREES
