@@ -82,6 +82,13 @@ for line in shap_times():
 from probe_common import shap_hw_equality
 print(shap_hw_equality())
 """,
+    # A/B the two predict traversals on the device (PROFILE.md: gathers
+    # serialize on TPU; the windows formulation exists for exactly this).
+    "predict_ab": """
+from probe_common import predict_ab
+for line in predict_ab():
+    print(line)
+""",
 }
 
 
@@ -114,7 +121,7 @@ def run_step(name, timeout):
 
 def main():
     steps = sys.argv[1:] or ["matmul", "dt", "rf_chunk", "rf_full",
-                             "et_full", "shap", "shap_equiv"]
+                             "et_full", "shap", "shap_equiv", "predict_ab"]
     unknown = [s for s in steps if s not in STEP_SRC]
     if unknown:
         sys.exit(f"unknown step(s) {unknown}; known: {sorted(STEP_SRC)}")

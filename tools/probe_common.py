@@ -94,6 +94,36 @@ def shap_times():
     yield f"shap_cfg0_steady_s {time.time() - t0:.2f}"
 
 
+def predict_ab():
+    """Time both predict traversals (gather vs windows) on the device at
+    bench size, plus an equality check. Yields printable lines."""
+    import numpy as np
+
+    from flake16_framework_tpu.ops.trees import fit_forest_hist, predict_proba
+
+    rng = np.random.RandomState(5)
+    n = N_TESTS
+    x = rng.randn(n, 16).astype(np.float32)
+    y = (x[:, 1] + 0.5 * rng.randn(n)) > 0
+    forest = fit_forest_hist(
+        x, y, np.ones(n, np.float32), jax.random.PRNGKey(2),
+        n_trees=min(N_TREES, 50), bootstrap=True, random_splits=False,
+        sqrt_features=True, max_depth=48, max_nodes=2 * n, tree_chunk=25,
+    )
+    jax.block_until_ready(forest)
+    out = {}
+    for impl in ("gather", "windows"):
+        p = predict_proba(forest, x, impl=impl)
+        jax.block_until_ready(p)  # compile
+        t0 = time.time()
+        p = predict_proba(forest, x, impl=impl)
+        jax.block_until_ready(p)
+        out[impl] = p
+        yield f"predict_{impl}_steady_s {time.time() - t0:.3f}"
+    d = float(abs(np.asarray(out["gather"]) - np.asarray(out["windows"])).max())
+    yield f"predict_impl_maxabs_diff {d:.3e}"
+
+
 def shap_hw_equality():
     """Pallas kernel on the REAL device vs the XLA formulation, mixed small
     forest (bootstrap weights, sub-lane feature count path not exercised —
