@@ -43,6 +43,7 @@ random order skipping constant features, pure nodes never split.
 """
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -477,14 +478,17 @@ def _fit_one_tree(x, y01, w, key, order0, xsorted, *, random_splits,
 # same ensemble parity budget.
 # --------------------------------------------------------------------------
 
-HIST_BINS = 64
+# Histogram-grower tuning knobs. Env-overridable (read at import) so the
+# hardware tuning sweep (tools/hw_probe.py "tune_hist") can vary them per
+# subprocess without code edits; defaults are the shipped configuration.
+HIST_BINS = int(os.environ.get("F16_HIST_BINS", "64"))
 # Node-batch width of the hist grower's BFS step, per backend: the MXU
 # wants wide one-hot matmuls (128 untuned pending hardware time); CPU pays
 # per-step cost proportional to the batch width (segment space + padded
 # slots) — measured there: 16 -> 0.19 s, 64 -> 0.54 s, 128 -> 1.2 s for a
 # 25-tree fit at N=800 (mostly-empty windows at the top of every tree).
-HIST_NODE_BATCH = 128
-HIST_NODE_BATCH_CPU = 16
+HIST_NODE_BATCH = int(os.environ.get("F16_HIST_NODE_BATCH", "128"))
+HIST_NODE_BATCH_CPU = int(os.environ.get("F16_HIST_NODE_BATCH_CPU", "16"))
 
 
 def quantile_edges(x, n_bins=HIST_BINS):
@@ -844,8 +848,9 @@ def fit_forest(x, y, w, key, *, n_trees, bootstrap, random_splits,
                   jnp.int32(max_depth))
 
 
-# Window width of the gather-free predict sweep (lane-dim friendly).
-PREDICT_WINDOW = 128
+# Window width of the gather-free predict sweep (lane-dim friendly;
+# env-overridable for the hardware tuning sweep like the hist knobs).
+PREDICT_WINDOW = int(os.environ.get("F16_PREDICT_WINDOW", "128"))
 
 
 @functools.partial(jax.jit, static_argnames=("impl",))

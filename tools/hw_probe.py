@@ -92,8 +92,9 @@ for line in predict_ab():
 }
 
 
-def run_step(name, timeout):
+def run_step(name, timeout, env_extra=None, tag=None):
     env = dict(os.environ)
+    env.update(env_extra or {})
     env["PYTHONPATH"] = os.path.join(REPO, "tools") + ":" + env.get(
         "PYTHONPATH", "")
     t0 = time.time()
@@ -103,14 +104,14 @@ def run_step(name, timeout):
             capture_output=True, text=True, cwd=REPO, env=env,
         )
         out = {
-            "step": name, "ok": r.returncode == 0,
+            "step": tag or name, "ok": r.returncode == 0,
             "wall_s": round(time.time() - t0, 2),
             "out": r.stdout.strip().splitlines()[-8:],
         }
         if r.returncode != 0:
             out["err"] = (r.stderr or "")[-400:]
     except subprocess.TimeoutExpired:
-        out = {"step": name, "ok": False, "timeout_s": timeout,
+        out = {"step": tag or name, "ok": False, "timeout_s": timeout,
                "wall_s": round(time.time() - t0, 2)}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as fd:
@@ -119,14 +120,37 @@ def run_step(name, timeout):
     return out["ok"]
 
 
+def tune_hist():
+    """Sweep the hist-grower knobs over the chunk-fit step, one subprocess
+    per combo (the knobs are read at import). Stops the sweep if a combo
+    fails (tunnel state unknown)."""
+    for bins in (32, 64):
+        for bw in (64, 128, 256):
+            ok = run_step(
+                "rf_chunk", 600,
+                env_extra={"F16_HIST_BINS": str(bins),
+                           "F16_HIST_NODE_BATCH": str(bw)},
+                tag=f"rf_chunk_b{bins}_w{bw}",
+            )
+            if not ok:
+                return False
+    return True
+
+
 def main():
     steps = sys.argv[1:] or ["matmul", "dt", "rf_chunk", "rf_full",
                              "et_full", "shap", "shap_equiv", "predict_ab"]
-    unknown = [s for s in steps if s not in STEP_SRC]
+    unknown = [s for s in steps if s not in STEP_SRC and s != "tune_hist"]
     if unknown:
-        sys.exit(f"unknown step(s) {unknown}; known: {sorted(STEP_SRC)}")
+        sys.exit(f"unknown step(s) {unknown}; known: "
+                 f"{sorted(STEP_SRC) + ['tune_hist']}")
     timeouts = {"matmul": 120, "dt": 420}
     for name in steps:
+        if name == "tune_hist":
+            if not tune_hist():
+                print("tune_hist aborted — stopping", file=sys.stderr)
+                break
+            continue
         ok = run_step(name, timeouts.get(name, 600))
         if not ok:
             print(f"step {name} failed — stopping (tunnel state unknown)",
