@@ -48,7 +48,17 @@ SHAP_EXPLAIN = int(os.environ.get("BENCH_SHAP_EXPLAIN", "512"))
 # multi-minute single dispatches (PROFILE.md "device-fault envelope"), so the
 # worker splits ensemble fits and SHAP explains into bounded slices
 # (bit-identical results; see sweep.py dispatch_trees / treeshap tree_chunk).
-DISPATCH_TREES = int(os.environ.get("BENCH_DISPATCH_TREES", "25"))
+def dispatch_env():
+    """(dispatch_trees, dispatch_folds) from the BENCH_* env knobs — the one
+    parser shared with parity.py. 0 or unset means off."""
+    dt = int(os.environ.get("BENCH_DISPATCH_TREES", "25")) or None
+    # Fold-axis bound (for single-tree fits); default off — a 10-fold DT
+    # fit is far from the fault envelope at bench sizes.
+    df = int(os.environ.get("BENCH_DISPATCH_FOLDS", "0")) or None
+    return dt, df
+
+
+DISPATCH_TREES, DISPATCH_FOLDS = dispatch_env()
 
 # Probe configs (BASELINE.json "configs" №1-3 + family coverage).
 CONFIGS = [
@@ -220,7 +230,8 @@ def worker(n_tests, n_trees):
     overrides = {"Random Forest": n_trees, "Extra Trees": n_trees}
     engine = SweepEngine(feats, labels, projects, names, pids,
                          tree_overrides=overrides,
-                         dispatch_trees=DISPATCH_TREES)
+                         dispatch_trees=DISPATCH_TREES,
+                         dispatch_folds=DISPATCH_FOLDS)
 
     # Warm-up: compile each family graph once (steady-state measurement —
     # one compile serves all configs of a family across the full 216 grid).

@@ -255,24 +255,62 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
 
 
 def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
-                 dc, *, tree_axis):
+                 dc, *, tree_axis, fold_chunk=None):
     """The dispatch-chunked fit protocol, shared by the single-device and
-    mesh-batched paths: one prep+resample dispatch, then ceil(T/dc)
-    bounded-duration tree-growth dispatches (each blocked — PROFILE.md fault
-    envelope), forests concatenated on ``tree_axis``. Bit-identical to the
-    corresponding single-dispatch fit: both read the same per-tree key
-    table. Returns (forest, xp, y) with the forest fully materialized, so
-    callers' t_train clocks include the concat."""
+    mesh-batched paths: one prep+resample dispatch, then bounded-duration
+    tree-growth dispatches (each blocked — PROFILE.md fault envelope),
+    forests concatenated back together. Bit-identical to the corresponding
+    single-dispatch fit: both read the same per-tree key table. Returns
+    (forest, xp, y) with the forest fully materialized, so callers' t_train
+    clocks include the concat.
+
+    Two chunk axes, composable with either alone:
+    - ``dc`` slices the per-tree key table (``tree_axis``) — the ensemble
+      bound;
+    - ``fold_chunk`` slices the fold axis (axis 0 of the prepped tensors;
+      single-device path only) — the bound for single-tree models, whose
+      whole fit is ``n_folds`` concurrent tree growths in one dispatch.
+    """
+    assert fold_chunk is None or tree_axis == 1, (
+        "fold_chunk applies to the single-device path only"
+    )
     xs, ys, ws, edges, xp, y = prep_fn(*fit_args)
     tks = tree_keys_thunk()
-    sl = (slice(None),) * tree_axis
-    parts = []
-    for lo in range(0, n_trees, dc):
-        forest_c = fit_chunk_fn(xs, ys, ws, edges,
-                                tks[sl + (slice(lo, lo + dc),)])
-        jax.block_until_ready(forest_c)
-        parts.append(forest_c)
-    forest = trees.concat_trees(parts, axis=tree_axis)
+    n_folds = xs.shape[0]
+    step = dc if dc is not None else n_trees
+    if fold_chunk is not None and fold_chunk < n_folds:
+        fold_ranges = [(flo, min(flo + fold_chunk, n_folds))
+                       for flo in range(0, n_folds, fold_chunk)]
+    else:
+        fold_ranges = [(0, n_folds)]
+
+    fold_parts = []
+    for flo, fhi in fold_ranges:
+        parts = []
+        for lo in range(0, n_trees, step):
+            if tree_axis == 1:  # single-device: tensors [folds, ...]
+                forest_c = fit_chunk_fn(
+                    xs[flo:fhi], ys[flo:fhi], ws[flo:fhi], edges,
+                    tks[flo:fhi, lo:lo + step],
+                )
+            else:               # mesh batch: tensors [B, folds, ...]
+                forest_c = fit_chunk_fn(xs, ys, ws, edges,
+                                        tks[:, :, lo:lo + step])
+            jax.block_until_ready(forest_c)
+            parts.append(forest_c)
+        fold_parts.append(parts[0] if len(parts) == 1
+                          else trees.concat_trees(parts, axis=tree_axis))
+    if len(fold_parts) == 1:
+        forest = fold_parts[0]
+    else:
+        # Axis 0 here is the FOLD axis, so the fold-broadcast max_depth
+        # (shape [fold_chunk]) must be concatenated along with the tree
+        # fields (concat_trees leaves it alone by design — it has no tree
+        # axis).
+        forest = trees.concat_trees(fold_parts, axis=0)._replace(
+            max_depth=jnp.concatenate(
+                [p.max_depth for p in fold_parts])
+        )
     jax.block_until_ready(forest)
     return forest, xp, y
 
@@ -289,7 +327,7 @@ class SweepEngine:
     def __init__(self, features, labels_raw, projects, project_names,
                  project_ids, *, mesh=None, max_depth=48, seed=0,
                  n_folds=None, tree_overrides=None, cv="stratified",
-                 dispatch_trees=None):
+                 dispatch_trees=None, dispatch_folds=None):
         self.features = np.asarray(features, dtype=np.float32)
         self.labels_raw = np.asarray(labels_raw, dtype=np.int32)
         self.projects = projects
@@ -299,11 +337,22 @@ class SweepEngine:
         self.max_depth = max_depth
         self.seed = seed
         self.cv = cv
-        # Upper bound on trees grown per device dispatch in run_config
-        # (ensembles split into ceil(T/dispatch_trees) fit dispatches,
-        # bit-identical results). Bounds single-dispatch duration: the TPU
-        # tunnel faults on multi-minute dispatches (PROFILE.md).
+        # Upper bounds on work per device dispatch in run_config
+        # (bit-identical results; single-dispatch duration control — the
+        # TPU tunnel faults on multi-minute dispatches, PROFILE.md):
+        # dispatch_trees splits ensembles into ceil(T/dc) fit dispatches;
+        # dispatch_folds splits the fold axis (the bound that matters for
+        # single-tree models, where one dispatch is n_folds tree growths).
         self.dispatch_trees = dispatch_trees
+        if (dispatch_folds is not None and mesh is not None
+                and mesh.devices.size > 1):
+            # run_config_batch keeps the fold axis inside each shard; a
+            # silently-ignored bound would defeat its purpose.
+            raise ValueError(
+                "dispatch_folds is a single-device knob; the mesh-batched "
+                "path only supports dispatch_trees"
+            )
+        self.dispatch_folds = dispatch_folds
         # tests shrink ensembles: {"Random Forest": 10, ...}
         self.tree_overrides = tree_overrides or {}
         self._fns = {}
@@ -378,12 +427,17 @@ class SweepEngine:
         )
         n_trees = self._spec(model_name).n_trees
         dc = self.dispatch_trees
+        if dc is not None and n_trees <= dc:
+            dc = None
+        df = self.dispatch_folds
+        if df is not None and self.n_folds <= df:
+            df = None
 
         t0 = time.time()
-        if dc is not None and n_trees > dc:
+        if dc is not None or df is not None:
             forest, xp, y = _chunked_fit(
                 cv_prep, cv_fit_chunk, lambda: cv_tree_keys(key), fit_args,
-                n_trees, dc, tree_axis=1,
+                n_trees, dc, tree_axis=1, fold_chunk=df,
             )
         else:
             forest, xp, y = cv_fit(*fit_args)

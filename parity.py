@@ -137,6 +137,7 @@ def ours_config_f1s(feats, labels, pids, keys, *, n_trees, seeds):
     """Our jitted sweep for one config across seeds. One engine serves all
     seeds: the PRNG key is a traced argument of the compiled CV program
     (sweep.py run_config), so varying ``engine.seed`` hits the jit cache."""
+    from bench import dispatch_env as _dispatch_env
     from flake16_framework_tpu.parallel.sweep import SweepEngine
 
     names = [f"project{p:02d}" for p in range(int(pids.max()) + 1)]
@@ -144,10 +145,10 @@ def ours_config_f1s(feats, labels, pids, keys, *, n_trees, seeds):
     engine = SweepEngine(
         feats, labels, projects, names, pids,
         tree_overrides={"Random Forest": n_trees, "Extra Trees": n_trees},
-        # Bounded dispatches (same default as bench.py): the full tier runs
-        # 100-tree x 10-fold fits on the TPU tunnel, which faults on
-        # multi-minute single dispatches (PROFILE.md).
-        dispatch_trees=int(os.environ.get("BENCH_DISPATCH_TREES", "25")),
+        # Bounded dispatches (same env knobs/defaults as bench.py): the
+        # full tier runs 100-tree x 10-fold fits on the TPU tunnel, which
+        # faults on multi-minute single dispatches (PROFILE.md).
+        **dict(zip(("dispatch_trees", "dispatch_folds"), _dispatch_env())),
     )
     out = []
     for s in seeds:

@@ -202,3 +202,24 @@ def test_sharded_dispatch_chunked_matches_unchunked():
     for keys in configs:
         assert a[keys][3] == b[keys][3], keys
         assert a[keys][2] == b[keys][2], keys
+
+
+def test_fold_chunked_fit_matches_single_dispatch(engine):
+    # dispatch_folds bounds the single-tree (DT) fit, whose whole dispatch
+    # is n_folds concurrent tree growths; slicing the fold axis must be
+    # bit-identical (composes with dispatch_trees for ensembles).
+    chunked = sweep.SweepEngine(
+        engine.features, engine.labels_raw, engine.projects,
+        engine.project_names, engine.project_ids,
+        max_depth=24, tree_overrides={"Extra Trees": 8, "Random Forest": 8},
+        dispatch_folds=4,   # 10 folds -> 4+4+2
+        dispatch_trees=3,
+    )
+    for keys in [
+        ("NOD", "Flake16", "None", "None", "Decision Tree"),
+        ("OD", "Flake16", "Scaling", "SMOTE", "Random Forest"),
+    ]:
+        a = engine.run_config(keys)
+        b = chunked.run_config(keys)
+        assert a[3] == b[3], keys
+        assert a[2] == b[2], keys
