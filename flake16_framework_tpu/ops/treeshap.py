@@ -22,6 +22,7 @@ tests enforce, alongside a brute-force subset-enumeration oracle on tiny trees.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -318,8 +319,11 @@ def _xla_forest_shap(forest, x, *, depth, sample_chunk=None):
 #   gathers, which TPU lacks along sublanes). Per-tree real-leaf counts are
 #   scalar-prefetched so padded leaf blocks predicate off.
 
-_SBLK = 128
-_LBLK = 8
+# Env-overridable for the hardware tuning session (read at import, like
+# the tree-grower knobs — tools/hw_probe.py runs each combo in a fresh
+# subprocess). Defaults are the shipped configuration.
+_SBLK = int(os.environ.get("F16_SHAP_SBLK", "128"))
+_LBLK = int(os.environ.get("F16_SHAP_LBLK", "8"))
 
 
 def _shap_kernel(n_leaves_ref, sf, sthr, sratio, sleft, svalid, leaf_p0,
