@@ -50,6 +50,10 @@ def main(argv=None):
                 kw["cv"] = "lopo"  # default out_file follows the cv scheme
             elif a.startswith("profile="):
                 kw["profile_dir"] = a.split("=", 1)[1]
+            elif a.startswith("dispatch="):
+                # bounded fit dispatches (fault-envelope control, see
+                # PROFILE.md): trees per dispatch, as in the bench
+                kw["dispatch_trees"] = int(a.split("=", 1)[1]) or None
             else:
                 raise ValueError(f"Unrecognized scores option {a!r}")
         write_scores(**kw)

@@ -35,7 +35,8 @@ def _load_arrays(tests_file):
 def write_scores(tests_file=TESTS_FILE, out_file=None, *,
                  max_depth=48, tree_overrides=None, configs=None,
                  checkpoint_every=12, progress_out=sys.stdout,
-                 cv="stratified", mesh=None, profile_dir=None):
+                 cv="stratified", mesh=None, profile_dir=None,
+                 dispatch_trees=None, dispatch_folds=None):
     """Run the (216-config x 10-fold) sweep and pickle the reference-schema
     scores dict. Resumes from an existing partial ``out_file``.
 
@@ -57,6 +58,7 @@ def write_scores(tests_file=TESTS_FILE, out_file=None, *,
     engine = SweepEngine(
         feats, labels, projects, names, pids, max_depth=max_depth,
         tree_overrides=tree_overrides, cv=cv, mesh=mesh,
+        dispatch_trees=dispatch_trees, dispatch_folds=dispatch_folds,
     )
 
     ledger = {}
