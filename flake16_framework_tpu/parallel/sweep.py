@@ -274,6 +274,24 @@ def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
     assert fold_chunk is None or tree_axis == 1, (
         "fold_chunk applies to the single-device path only"
     )
+
+    def run_bounded(thunk):
+        """Dispatch + block, retrying ONCE on a transient device fault.
+        Chunks are deterministic (explicit key slices), so a retry is
+        bit-identical; only the tunnel's fault signature is retried —
+        anything else propagates."""
+        try:
+            out = thunk()
+            jax.block_until_ready(out)
+            return out
+        except Exception as e:  # jaxlib runtime errors share no base class
+            if "UNAVAILABLE" not in str(e):
+                raise
+            time.sleep(5)
+            out = thunk()
+            jax.block_until_ready(out)
+            return out
+
     xs, ys, ws, edges, xp, y = prep_fn(*fit_args)
     tks = tree_keys_thunk()
     n_folds = xs.shape[0]
@@ -289,14 +307,14 @@ def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
         parts = []
         for lo in range(0, n_trees, step):
             if tree_axis == 1:  # single-device: tensors [folds, ...]
-                forest_c = fit_chunk_fn(
+                forest_c = run_bounded(lambda: fit_chunk_fn(
                     xs[flo:fhi], ys[flo:fhi], ws[flo:fhi], edges,
                     tks[flo:fhi, lo:lo + step],
-                )
+                ))
             else:               # mesh batch: tensors [B, folds, ...]
-                forest_c = fit_chunk_fn(xs, ys, ws, edges,
-                                        tks[:, :, lo:lo + step])
-            jax.block_until_ready(forest_c)
+                forest_c = run_bounded(lambda: fit_chunk_fn(
+                    xs, ys, ws, edges, tks[:, :, lo:lo + step],
+                ))
             parts.append(forest_c)
         fold_parts.append(parts[0] if len(parts) == 1
                           else trees.concat_trees(parts, axis=tree_axis))

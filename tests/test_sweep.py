@@ -223,3 +223,56 @@ def test_fold_chunked_fit_matches_single_dispatch(engine):
         b = chunked.run_config(keys)
         assert a[3] == b[3], keys
         assert a[2] == b[2], keys
+
+
+def test_chunked_fit_retries_transient_unavailable():
+    # A chunk dispatch that faults with the tunnel's UNAVAILABLE signature
+    # is retried once (chunks are deterministic); other errors propagate.
+    import jax.numpy as jnp
+
+    from flake16_framework_tpu.ops import trees as T
+
+    n_folds, n, f, t = 2, 8, 3, 4
+    xs = jnp.zeros((n_folds, n, f))
+    ys = jnp.zeros((n_folds, n), bool)
+    ws = jnp.ones((n_folds, n))
+
+    def prep_fn(*a):
+        return xs, ys, ws, None, jnp.zeros((n, f)), jnp.zeros((n,), bool)
+
+    def keys_thunk():
+        return jnp.zeros((n_folds, t, 2), jnp.uint32)
+
+    def make_forest(c):
+        z = jnp.zeros((n_folds, c, 8), jnp.int32)
+        return T.Forest(z, z.astype(jnp.float32), z, z,
+                        jnp.zeros((n_folds, c, 8, 2)),
+                        jnp.zeros((n_folds, c), jnp.int32),
+                        jnp.full((n_folds,), 8, jnp.int32))
+
+    calls = {"n": 0}
+
+    def flaky_chunk(xs_, ys_, ws_, edges, tk):
+        calls["n"] += 1
+        if calls["n"] == 2:  # fault exactly once, on the second chunk
+            raise RuntimeError("UNAVAILABLE: TPU device error (fake)")
+        return make_forest(tk.shape[1])
+
+    import time as _time
+    orig_sleep = _time.sleep
+    _time.sleep = lambda s: None  # no 5 s pause in tests
+    try:
+        forest, _, _ = sweep._chunked_fit(
+            prep_fn, flaky_chunk, keys_thunk, (), t, 2, tree_axis=1,
+        )
+    finally:
+        _time.sleep = orig_sleep
+    assert calls["n"] == 3  # chunk1 ok, chunk2 faulted, chunk2 retried
+    assert forest.feature.shape == (n_folds, t, 8)
+
+    def dead_chunk(*a):
+        raise RuntimeError("INTERNAL: something else")
+
+    with pytest.raises(RuntimeError, match="INTERNAL"):
+        sweep._chunked_fit(prep_fn, dead_chunk, keys_thunk, (), t, 2,
+                           tree_axis=1)
