@@ -132,12 +132,18 @@ def _select_features(nc, key, max_features):
     skip constants, stop after ``max_features`` non-constant ones.
 
     nc: [W, F] bool — feature non-constant within node.
+    ``key`` is either one uint32 key [2] (one draw covering all rows) or
+    per-row keys [W, 2]; the hist grower passes per-node keys derived from
+    global node ids so the node-batch width stays results-neutral.
     Returns sel [W, F] bool. With fewer than max_features non-constant
     features, all of them are selected (sklearn exhausts the draw).
     """
     if max_features is None:
         return nc
-    u = jax.random.uniform(key, nc.shape)
+    if key.ndim == 2:
+        u = jax.vmap(lambda k: jax.random.uniform(k, nc.shape[1:]))(key)
+    else:
+        u = jax.random.uniform(key, nc.shape)
     r = jnp.where(nc, u, jnp.inf)
     kth = jnp.sort(r, axis=1)[:, max_features - 1 : max_features]
     return (r <= kth) & nc
@@ -487,6 +493,8 @@ HIST_BINS = int(os.environ.get("F16_HIST_BINS", "64"))
 # per-step cost proportional to the batch width (segment space + padded
 # slots) — measured there: 16 -> 0.19 s, 64 -> 0.54 s, 128 -> 1.2 s for a
 # 25-tree fit at N=800 (mostly-empty windows at the top of every tree).
+# Results-neutral: per-node RNG keys derive from global node ids (see
+# step() in _fit_one_tree_hist), so any width grows the same forest.
 HIST_NODE_BATCH = int(os.environ.get("F16_HIST_NODE_BATCH", "128"))
 HIST_NODE_BATCH_CPU = int(os.environ.get("F16_HIST_NODE_BATCH_CPU", "16"))
 
@@ -549,7 +557,12 @@ def _fit_one_tree_hist(ohfb, bin_idx, edges, y01, w, key, *, random_splits,
     def step(state):
         (feature, threshold, left, right, value, depth, a, p,
          sample_node) = state
-        kf, kt = jax.random.split(jax.random.fold_in(key, p))
+        # Per-NODE keys from global node ids — not from the window start —
+        # so HIST_NODE_BATCH(_CPU) is a pure perf knob: any width grows the
+        # same forest from the same ``key``.
+        nkeys = jax.vmap(lambda d: jax.random.fold_in(key, d))(p + iota_w)
+        ksplit = jax.vmap(jax.random.split)(nkeys)     # [W, 2, 2]
+        kf, kt = ksplit[:, 0], ksplit[:, 1]
 
         # ---- node membership + class histograms ---------------------------
         # Two formulations of the same [F, W, B] histograms, chosen by
@@ -601,7 +614,9 @@ def _fit_one_tree_hist(ohfb, bin_idx, edges, y01, w, key, *, random_splits,
             lo = jnp.argmax(occ, axis=-1)              # [F, W]
             hi = n_bins - 1 - jnp.argmax(jnp.flip(occ, -1), axis=-1)
             span = jnp.maximum(hi - lo, 1)
-            u = jax.random.uniform(kt, (n_feat, bw), dtype=dt)
+            u = jax.vmap(
+                lambda k: jax.random.uniform(k, (n_feat,), dtype=dt)
+            )(kt).T                                    # [F, W], per-node keys
             bsel = lo + 1 + jnp.floor(u * span).astype(jnp.int32)
             ohb = jax.nn.one_hot(bsel - 1, n_bins - 1, dtype=jnp.float32)
             lw_j = jnp.sum(lw * ohb, -1)

@@ -279,13 +279,18 @@ def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
         """Dispatch + block, retrying ONCE on a transient device fault.
         Chunks are deterministic (explicit key slices), so a retry is
         bit-identical; only the tunnel's fault signature is retried —
-        anything else propagates."""
+        anything else propagates. A failed retry raises, aborting the whole
+        fit (no per-chunk catch exists above this), so a hard-down tunnel
+        costs one sleep + re-dispatch per process, not per chunk."""
         try:
             out = thunk()
             jax.block_until_ready(out)
             return out
         except Exception as e:  # jaxlib runtime errors share no base class
-            if "UNAVAILABLE" not in str(e):
+            # XlaRuntimeError carries the gRPC status as a message prefix;
+            # an incidental "UNAVAILABLE" elsewhere in a message is not a
+            # device fault and must propagate.
+            if not str(e).startswith("UNAVAILABLE"):
                 raise
             time.sleep(5)
             out = thunk()

@@ -176,15 +176,18 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
         params = dict(n_tests=n_tests, n_trees=n_trees, data_seed=data_seed,
                       nod_bump=nod_bump, od_bump=od_bump,
                       noise_sigma=noise_sigma)
-        # Absent field = cache produced at the *generation-time* defaults
-        # (run_parity's signature), NOT this run's values — falling back to
-        # `val` would make the check vacuous for non-default runs.
-        gen_defaults = dict(data_seed=7, nod_bump=2.5, od_bump=1.8,
-                            noise_sigma=0.35)
+        # Every dataset parameter is recorded in the cache at generation
+        # time (``--gen-cache``), so compatibility is cache-vs-run with no
+        # defaults fallback: a fallback to either the historical or the
+        # current signature defaults can silently validate a stale cache
+        # when a default changes between generation and use.
         for name, val in params.items():
-            got = cache.get(name, gen_defaults.get(name))
-            assert got == val, (
-                f"sklearn cache {name}={got} != this run's {val}"
+            assert name in cache, (
+                f"sklearn cache lacks {name!r} — regenerate it (old caches "
+                "without recorded dataset params are not trusted)"
+            )
+            assert cache[name] == val, (
+                f"sklearn cache {name}={cache[name]} != this run's {val}"
             )
     feats, labels, pids = make_dataset(
         n_tests=n_tests, seed=data_seed, nod_bump=nod_bump, od_bump=od_bump,
@@ -227,8 +230,38 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
     return report
 
 
+def gen_cache(out_path, *, n_tests=4000, n_trees=100, k=6, data_seed=7,
+              nod_bump=2.5, od_bump=1.8, noise_sigma=0.35):
+    """Precompute the sklearn side of the full tier (~1 h single-core) and
+    write it with EVERY dataset parameter recorded, so ``run_parity``'s
+    cache-compat check never needs a defaults fallback."""
+    from flake16_framework_tpu.utils.synth import make_dataset
+
+    feats, labels, _ = make_dataset(
+        n_tests=n_tests, seed=data_seed, nod_bump=nod_bump, od_bump=od_bump,
+        noise_sigma=noise_sigma,
+    )
+    f1s = {}
+    for keys in PROBE_CONFIGS:
+        f1s["/".join(keys)] = [
+            sklearn_config_f1(feats, labels, keys, n_trees=n_trees, seed=s)
+            for s in range(k)
+        ]
+        print(json.dumps({keys[4]: f1s["/".join(keys)]}), flush=True)
+    out = {"n_tests": n_tests, "n_trees": n_trees, "k": k,
+           "data_seed": data_seed, "nod_bump": nod_bump, "od_bump": od_bump,
+           "noise_sigma": noise_sigma, "f1s": f1s}
+    with open(out_path, "w") as fd:
+        json.dump(out, fd, indent=2)
+    return out
+
+
 def main():
     full = "--full" in sys.argv
+    if "--gen-cache" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--gen-cache") + 1]
+        gen_cache(out_path)
+        return
     if full:
         rep = run_parity(
             n_tests=4000, n_trees=100, k_ours=6, k_sk=6,

@@ -225,7 +225,7 @@ def test_fold_chunked_fit_matches_single_dispatch(engine):
         assert a[2] == b[2], keys
 
 
-def test_chunked_fit_retries_transient_unavailable():
+def test_chunked_fit_retries_transient_unavailable(monkeypatch):
     # A chunk dispatch that faults with the tunnel's UNAVAILABLE signature
     # is retried once (chunks are deterministic); other errors propagate.
     import jax.numpy as jnp
@@ -259,14 +259,10 @@ def test_chunked_fit_retries_transient_unavailable():
         return make_forest(tk.shape[1])
 
     import time as _time
-    orig_sleep = _time.sleep
-    _time.sleep = lambda s: None  # no 5 s pause in tests
-    try:
-        forest, _, _ = sweep._chunked_fit(
-            prep_fn, flaky_chunk, keys_thunk, (), t, 2, tree_axis=1,
-        )
-    finally:
-        _time.sleep = orig_sleep
+    monkeypatch.setattr(_time, "sleep", lambda s: None)  # no 5 s pause
+    forest, _, _ = sweep._chunked_fit(
+        prep_fn, flaky_chunk, keys_thunk, (), t, 2, tree_axis=1,
+    )
     assert calls["n"] == 3  # chunk1 ok, chunk2 faulted, chunk2 retried
     assert forest.feature.shape == (n_folds, t, 8)
 
@@ -276,3 +272,16 @@ def test_chunked_fit_retries_transient_unavailable():
     with pytest.raises(RuntimeError, match="INTERNAL"):
         sweep._chunked_fit(prep_fn, dead_chunk, keys_thunk, (), t, 2,
                            tree_axis=1)
+
+    # The retry keys on the gRPC status PREFIX: an incidental "UNAVAILABLE"
+    # later in an unrelated message must propagate without a re-dispatch.
+    calls["n"] = 0
+
+    def misleading_chunk(*a):
+        calls["n"] += 1
+        raise RuntimeError("INTERNAL: upstream said UNAVAILABLE in passing")
+
+    with pytest.raises(RuntimeError, match="INTERNAL"):
+        sweep._chunked_fit(prep_fn, misleading_chunk, keys_thunk, (), t, 2,
+                           tree_axis=1)
+    assert calls["n"] == 1  # no second attempt

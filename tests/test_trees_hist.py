@@ -184,6 +184,35 @@ def test_hist_impl_formulations_agree_bitwise():
                                       np.asarray(getattr(b, f)), err_msg=f)
 
 
+def test_hist_node_batch_width_is_results_neutral(monkeypatch):
+    # Per-node RNG keys derive from global node ids, not the window start,
+    # so the node-batch width (a backend-tuned perf knob) must not change
+    # the grown forest: a hardware tuning sweep may ship any width without
+    # a parity re-check, and CPU (16) vs TPU (128) fits stay reproducible.
+    from flake16_framework_tpu.ops import trees as trees_mod
+
+    rng = np.random.RandomState(23)
+    n = 300
+    x = rng.randn(n, 12).astype(np.float32)
+    y = (x[:, 0] - x[:, 5] + 0.6 * rng.randn(n)) > 0
+    w = np.ones(n, np.float32)
+    kw = dict(n_trees=4, bootstrap=True, sqrt_features=True,
+              max_depth=10, max_nodes=400)
+    fit_unjit = fit_forest_hist.__wrapped__  # re-trace so the knob is re-read
+    for random_splits in (False, True):
+        got = []
+        for bw in (16, 128):
+            monkeypatch.setattr(trees_mod, "HIST_NODE_BATCH_CPU", bw)
+            monkeypatch.setattr(trees_mod, "HIST_NODE_BATCH", bw)
+            got.append(fit_unjit(x, y, w, jax.random.PRNGKey(11),
+                                 random_splits=random_splits, **kw))
+        a, b = got
+        for f in a._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+                err_msg=f"{f} rs={random_splits}")
+
+
 def test_predict_windows_matches_gather():
     # The gather-free window-routing predict (TPU formulation) must agree
     # with the classic gather traversal for forests from BOTH growers
