@@ -18,13 +18,14 @@ testable without Docker (this environment has none).
 import functools
 import os
 import shlex
+import shutil
 import subprocess as sp
 import sys
 
 from flake16_framework_tpu.constants import (
     CONT_DATA_DIR, CONT_TIMEOUT, DATA_DIR, IMAGE_NAME, LOG_FILE,
-    N_RUNS, PIP_INSTALL, PIP_VERSION, PLUGIN_BLACKLIST, PLUGINS, STDOUT_DIR,
-    SUBJECTS_DIR,
+    N_RUNS, PIP_INSTALL, PIP_VERSION, PLUGIN_BLACKLIST, PLUGINS,
+    REQUIREMENTS_FILE, STDOUT_DIR, SUBJECTS_DIR,
 )
 from flake16_framework_tpu.runner.pool import run_pool
 from flake16_framework_tpu.runner.subjects import iter_subjects
@@ -52,18 +53,42 @@ def _venv_env(proj):
     return env
 
 
+def vendored_requirements(proj):
+    """Path of the repo-vendored pin file for ``proj``
+    (``subjects/<proj>/requirements.txt`` beside the package — the study's
+    frozen dependency resolutions, reference subjects/*/requirements.txt),
+    or None when the study data isn't vendored for this subject."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "subjects", proj, REQUIREMENTS_FILE,
+    )
+    return path if os.path.exists(path) else None
+
+
 def provision_subject(subject, exec_fn=sp.run):
     """Build one subject's pinned virtualenv (L1; reference setup_project
     experiment.py:110-125): venv, clone @ sha, pinned pip, plugins,
     subject editable install.
 
     Per-subject pins (``subjects/<proj>/requirements.txt`` — a pip freeze of
-    the resolved env at the pinned SHA) belong to a study run, not to the
-    framework; when absent, setup falls back to the subject's own unpinned
-    dependency resolution plus the plugins' one runtime dep (psutil) — fine
-    for smoke runs, not for replicating the study byte-for-byte."""
+    the resolved env at the pinned SHA) are seeded from the repo's vendored
+    copies of the study's freezes when the work dir has none. A work-dir pin
+    file always wins (a study re-freeze must be able to override the
+    vendored data); with neither, setup falls back to the subject's own
+    unpinned dependency resolution plus the plugins' one runtime dep
+    (psutil) — fine for smoke runs, not for replicating the study
+    byte-for-byte. Caveat: the vendored freezes were resolved for the
+    reference's py3.8 image; the py3.12 base (see Dockerfile) may need a
+    re-freeze for subjects whose pins predate 3.12 wheels."""
     paths = subject_paths(subject.name)
     env = _venv_env(subject.name)
+
+    if not os.path.exists(paths["requirements"]):
+        vendored = vendored_requirements(subject.name)
+        if vendored:
+            os.makedirs(os.path.dirname(paths["requirements"]), exist_ok=True)
+            shutil.copyfile(vendored, paths["requirements"])
 
     exec_fn(["virtualenv", paths["venv"]], check=True)
     exec_fn(["git", "clone", subject.url, paths["checkout"]], check=True)

@@ -170,6 +170,38 @@ def test_run_verb_enumerates_without_local_registry(tmp_path, monkeypatch):
     assert "loguru_testinspect_0" in names
 
 
+def test_provision_seeds_vendored_pins(tmp_path, monkeypatch):
+    # The repo vendors the study's frozen subjects/<proj>/requirements.txt;
+    # provisioning a study subject with a bare work dir must seed the pin
+    # file from the vendored copy and run the pinned install against it.
+    monkeypatch.setattr(R, "SUBJECTS_DIR", str(tmp_path))
+    rec = Recorder()
+    s = parse_subject_line(
+        "Delgan/loguru,abc123,.,python -m pytest tests"
+    )
+    R.provision_subject(s, exec_fn=rec)
+    seeded = tmp_path / "loguru" / "requirements.txt"
+    assert seeded.exists()
+    assert "psutil==5.8.0" in seeded.read_text()
+    joined = [" ".join(c) for c, _ in rec.calls]
+    assert any("-r " + str(seeded) in j for j in joined)
+    # pins carry psutil, so no unpinned extra is appended
+    assert not any(j.endswith("psutil") for j in joined)
+
+    # a work-dir pin file wins over the vendored copy (study re-freeze)
+    seeded.write_text("only-this==1.0\n")
+    R.provision_subject(s, exec_fn=Recorder())
+    assert seeded.read_text() == "only-this==1.0\n"
+
+
+def test_vendored_pins_cover_all_subjects():
+    # Replication contract: every registry subject has a vendored freeze.
+    from flake16_framework_tpu.runner.subjects import iter_subjects as it
+
+    missing = [s.name for s in it() if not R.vendored_requirements(s.name)]
+    assert missing == [], missing
+
+
 def test_provision_without_pins_falls_back_unpinned(tmp_path, monkeypatch):
     # No subjects/<proj>/requirements.txt: setup must not crash at the pinned
     # install; it installs the framework + psutil + subject with deps.
