@@ -9,11 +9,14 @@ model families) plus the 2 reference SHAP configs.
 Baseline (self-measured; the reference publishes no numbers): the same
 configs on the single-host CPU stack the reference uses — sklearn trees +
 this repo's numpy oracles for imblearn 0.9 resampling (imbalanced-learn is
-not installed) and for shap 0.40's path-dependent Tree SHAP (tests/
-ref_treeshap.py, oracle-validated; shap is not installed). Ours: the jitted
-JAX sweep + the Pallas Tree SHAP kernel, steady-state (one compiled graph
-per model family serves all of that family's configs across the 216-config
-grid, so compile time is excluded; SHAP likewise warms once per config).
+not installed) + a native C implementation of shap 0.40's path-dependent
+Tree SHAP (native/treeshap_cext.cc — shap itself is not installed, and a
+numpy stand-in would inflate the reported win; the C baseline is
+parity-tested against the numpy oracle in tests/test_native_treeshap.py).
+Ours: the jitted JAX sweep + the Pallas Tree SHAP kernel, steady-state (one
+compiled graph per model family serves all of that family's configs across
+the 216-config grid, so compile time is excluded; SHAP likewise warms once
+per config).
 
 Robustness: the accelerator runs in a SUBPROCESS. The TPU tunnel in this
 environment can fault or wedge (see ops/trees.py docstring); a crashed
@@ -175,15 +178,25 @@ def cpu_scores_baseline(feats, labels_raw, configs, n_trees):
 def cpu_shap_baseline(feats, labels_raw, n_trees):
     """Reference shap stage on CPU (experiment.py:504-530 semantics): per
     SHAP config, preprocess full data, fit on the balanced full set, explain
-    every sample with path-dependent Tree SHAP (numpy oracle). Returns
-    per-config wall-clock seconds."""
+    every sample with path-dependent Tree SHAP. The explainer is the native
+    C implementation of shap 0.40's algorithm (native/treeshap_cext.cc,
+    oracle-parity-tested) so the baseline is compiled-stack grade like the
+    reference's `_cext`; only with no toolchain does it drop to the numpy
+    oracle — flagged by the "which" tag, since an oracle-relative speedup
+    overstates a `_cext`-relative one. Returns (per-config seconds, which).
+    """
     import numpy as np
 
     from ref_treeshap import forest_shap_class0_ref, sklearn_forest_trees
+    from flake16_framework_tpu.native.baseline import forest_shap_class0_cext
     from flake16_framework_tpu import config as cfg
 
     rng = np.random.RandomState(0)
     times = []
+    which = "cext"
+    from flake16_framework_tpu import native
+    native.load("treeshap_cext")  # one-time g++ build OUTSIDE the clocks —
+    # ours excludes compile time, so the baseline must too
     for keys in cfg.SHAP_CONFIGS:
         t0 = time.time()
         fl_name, fs_name, prep_name, bal_name, model_name = keys
@@ -193,10 +206,13 @@ def cpu_shap_baseline(feats, labels_raw, n_trees):
         y = labels_raw == fl
         xb, yb = _np_balance(bal_name, x, y, rng)
         m = _sk_model(model_name, n_trees).fit(xb, yb)
-        forest_shap_class0_ref(sklearn_forest_trees(m),
-                               x[:min(SHAP_EXPLAIN, len(x))])
+        trees = sklearn_forest_trees(m)
+        xq = x[:min(SHAP_EXPLAIN, len(x))]
+        if forest_shap_class0_cext(trees, xq) is None:
+            which = "numpy_oracle"
+            forest_shap_class0_ref(trees, xq)
         times.append(time.time() - t0)
-    return times
+    return times, which
 
 
 def configure_jax_cache():
@@ -282,11 +298,22 @@ def probe():
 
     Also requires a non-CPU default backend: if JAX silently comes up
     CPU-only, the full-ensemble worker would burn both timeouts on a sweep
-    the CPU can't finish — route straight to the reduced-size fallback."""
+    the CPU can't finish — route straight to the reduced-size fallback.
+
+    When the device path is the axon tunnel (hook env set), a dead relay
+    listener is decisive — skip the 120 s jax probe and name the failure
+    precisely ('no listener' vs 'listener up but probe dead' are different
+    forensics). With no tunnel configured (e.g. a directly-attached
+    accelerator) the listener is irrelevant and the jax probe decides."""
+    from flake16_framework_tpu.utils.relay import RELAY_PORT, relay_listener_up
+
     code = ("import jax, jax.numpy as jnp;"
             "assert jax.default_backend() != 'cpu', 'cpu-only backend';"
             "x = jnp.ones((256, 256));"
             "print(float((x @ x)[0, 0]))")
+    if os.environ.get("PALLAS_AXON_POOL_IPS") and relay_listener_up() is False:
+        return False, (f"no relay listener on :{RELAY_PORT} "
+                       "(tunnel down; ss -tln)")
     try:
         r = subprocess.run([sys.executable, "-c", code], timeout=120,
                            capture_output=True, text=True, cwd=REPO)
@@ -294,7 +321,7 @@ def probe():
             return True, None
         return False, (r.stderr or "")[-200:]
     except subprocess.TimeoutExpired:
-        return False, "probe timeout (tunnel wedged?)"
+        return False, "probe timeout (listener up but device dead?)"
 
 
 def run_worker(n_tests, n_trees, env_extra=None):
@@ -366,14 +393,18 @@ def main():
 
     feats, labels, _, _, _ = make_data(n)
     t_base_scores = cpu_scores_baseline(feats, labels, CONFIGS, t)
-    t_base_shap = cpu_shap_baseline(feats, labels, t)
+    t_base_shap, shap_which = cpu_shap_baseline(feats, labels, t)
 
     t_ours = result["t_scores"] + result["t_shap"]
     t_base = sum(t_base_scores) + sum(t_base_shap)
     speedup = t_base / t_ours if t_ours > 0 else float("inf")
     detail.update(
         n_tests=n, n_trees=t, n_explain=min(SHAP_EXPLAIN, n),
-        shap_baseline="numpy path-dependent oracle (shap not installed)",
+        shap_baseline=(
+            "native C tree_shap (shap 0.40 algorithm, "
+            "native/treeshap_cext.cc)" if shap_which == "cext"
+            else "numpy path-dependent oracle (NO toolchain — speedup "
+                 "overstates a _cext-relative win)"),
         t_cpu_scores_s=round(sum(t_base_scores), 2),
         t_cpu_shap_s=round(sum(t_base_shap), 2),
         t_ours_scores_s=result["t_scores"], t_ours_shap_s=result["t_shap"],

@@ -1,11 +1,16 @@
-"""On-demand build + load of the native collation fast path.
+"""On-demand build + load of the native (C++) fast paths.
 
-``load()`` compiles ``collate_fast.cc`` into ``_collate_fast.so`` next to the
+``load(name)`` compiles ``<name>.cc`` into ``_<name>.so`` next to the
 source on first use (g++, CPython C API — no pybind11 in this image) and
 imports it; it returns None when no toolchain is available or the build
-fails, in which case runner/collate.py keeps its pure-Python implementations.
+fails, in which case callers keep their pure-Python implementations.
 The build is atomic (unique temp + rename) so concurrent processes race
 safely, and the .so is rebuilt whenever the source is newer.
+
+Modules:
+- ``collate_fast`` — L3 collation hot loops (runner/collate.py)
+- ``treeshap_cext`` — shap-0.40-equivalent C Tree SHAP, the bench's
+  single-host baseline (bench.py)
 """
 
 import importlib.util
@@ -14,44 +19,42 @@ import subprocess
 import sysconfig
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "collate_fast.cc")
-_SO = os.path.join(_DIR, "_collate_fast.so")
 
-_cached = False
-_module = None
+_cache = {}
 
 
-def _build():
+def _build(src, so):
     include = sysconfig.get_paths()["include"]
-    tmp = f"{_SO}.{os.getpid()}.tmp"
+    tmp = f"{so}.{os.getpid()}.tmp"
     try:
         subprocess.run(
-            ["g++", "-O3", "-fPIC", "-shared", f"-I{include}", _SRC,
+            ["g++", "-O3", "-fPIC", "-shared", f"-I{include}", src,
              "-o", tmp],
             check=True, capture_output=True, timeout=120,
         )
-        os.replace(tmp, _SO)
+        os.replace(tmp, so)
     finally:
         if os.path.exists(tmp):
             os.remove(tmp)
 
 
-def load():
-    """The native module, or None (cached after the first attempt)."""
-    global _cached, _module
-    if _cached:
-        return _module
-    _cached = True
+def load(name="collate_fast"):
+    """The named native module, or None (cached after the first attempt)."""
+    if name in _cache:
+        return _cache[name]
+    _cache[name] = None
+    src = os.path.join(_DIR, f"{name}.cc")
+    so = os.path.join(_DIR, f"_{name}.so")
     try:
-        if (not os.path.exists(_SO)
-                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-            _build()
+        if (not os.path.exists(so)
+                or os.path.getmtime(so) < os.path.getmtime(src)):
+            _build(src, so)
         spec = importlib.util.spec_from_file_location(
-            "flake16_framework_tpu.native._collate_fast", _SO
+            f"flake16_framework_tpu.native._{name}", so
         )
         module = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(module)
-        _module = module
+        _cache[name] = module
     except Exception:
-        _module = None
-    return _module
+        _cache[name] = None
+    return _cache[name]

@@ -1,0 +1,42 @@
+"""Python face of the native Tree SHAP baseline (treeshap_cext.cc).
+
+``forest_shap_class0_cext`` mirrors the numpy oracle's contract
+(tests/ref_treeshap.py ``forest_shap_class0_ref``: a list of per-tree
+``(children_left, children_right, feature, threshold, value01)`` tuples,
+as produced by ``sklearn_forest_trees``) so the bench can swap baselines
+1:1. Returns None when the native toolchain is unavailable."""
+
+import numpy as np
+
+from flake16_framework_tpu import native
+
+
+def forest_shap_class0_cext(forest_trees, x):
+    """Mean class-0 SHAP [S, F] over the forest via the C extension, or
+    None when it can't be built. Trees are padded to a common node count
+    with self-contained leaves (feature -1, zero cover) the recursion
+    never visits."""
+    mod = native.load("treeshap_cext")
+    if mod is None:
+        return None
+    t = len(forest_trees)
+    m = max(tree[0].shape[0] for tree in forest_trees)
+    left = np.full((t, m), -1, np.int32)
+    right = np.full((t, m), -1, np.int32)
+    feature = np.full((t, m), -1, np.int32)
+    threshold = np.zeros((t, m), np.float64)
+    value01 = np.zeros((t, m, 2), np.float64)
+    for i, (le, ri, fe, th, v) in enumerate(forest_trees):
+        k = le.shape[0]
+        left[i, :k] = le
+        right[i, :k] = ri
+        feature[i, :k] = fe
+        threshold[i, :k] = th
+        value01[i, :k] = v
+    x = np.ascontiguousarray(x, np.float64)
+    s, f = x.shape
+    phi = np.zeros((s, f), np.float64)
+    mod.forest_shap_class0(  # ndarrays pass as buffers, no copies
+        left, right, feature, threshold, value01, x, phi, t, m, s, f,
+    )
+    return phi

@@ -1,0 +1,23 @@
+"""Tunnel-relay liveness: the one definition of the relay port and its
+cheap check, shared by bench.py and tools/recovery_watch.py.
+
+The TPU in this environment is reached through a local relay; when its
+host side dies, every jax process hangs forever at backend init, so
+liveness must be established WITHOUT jax — a TCP listener probe via
+``ss -tln``. Decisive only where the relay is actually the device path
+(callers gate on the axon hook env)."""
+
+import subprocess
+
+RELAY_PORT = "8082"
+
+
+def relay_listener_up(timeout=10):
+    """True/False for a listener on the relay port; None when ``ss`` itself
+    is unavailable (callers must treat None as unknown, not down)."""
+    try:
+        r = subprocess.run(["ss", "-tln"], capture_output=True, text=True,
+                           timeout=timeout)
+        return (":" + RELAY_PORT) in r.stdout
+    except Exception:
+        return None
