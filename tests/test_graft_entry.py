@@ -33,6 +33,16 @@ def test_dryrun_multichip_driver_style():
     # Keep the parent off any real accelerator: the point is the re-exec
     # path, which must fire whenever the parent has < 8 devices.
     env["JAX_PLATFORMS"] = "cpu"
+    # Reduced shapes: this test pins the driver CONTRACT (self-provisioned
+    # virtual mesh, both CV passes, OK lines) in suite time. The driver's
+    # own run uses the production defaults (N=1000, 100-tree chunked
+    # ensembles, 26-fold LOPO, ~18 min serialized on one core) — measured
+    # walls recorded in PROFILE.md "Production-shape multichip dryrun".
+    env["F16_DRYRUN_N"] = "200"
+    env["F16_DRYRUN_TREES"] = "12"
+    # keep dispatch < trees so the chunked shard_map fit (the production
+    # fault-envelope path) stays exercised at the reduced shapes
+    env["F16_DRYRUN_DISPATCH"] = "5"
 
     r = subprocess.run(
         [sys.executable, "-c",
@@ -42,6 +52,18 @@ def test_dryrun_multichip_driver_style():
     assert r.returncode == 0, f"stdout={r.stdout[-800:]}\nstderr={r.stderr[-800:]}"
     assert "dryrun_multichip OK (stratified): 8 devices" in r.stdout
     assert "dryrun_multichip OK (lopo): 8 devices" in r.stdout
+
+    # The UNBOUNDED sharded fit (dispatch_trees=None, run_config_batch's
+    # fit_b branch) needs its own coverage — both passes above run chunked.
+    env["F16_DRYRUN_DISPATCH"] = "0"
+    env["F16_DRYRUN_PASSES"] = "lopo"
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert r.returncode == 0, f"stdout={r.stdout[-800:]}\nstderr={r.stderr[-800:]}"
+    assert "dispatch=None" in r.stdout
 
 
 def test_entry_lowers_single_device():

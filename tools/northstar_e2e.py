@@ -1,0 +1,133 @@
+"""One-command north-star sweep: every verb, full grid, both CV schemes.
+
+Chains the production pipeline end-to-end on a synthetic 26-subject
+tests.json — the full 216-config grid through ``write_scores`` (stratified
+AND 26-fold leave-one-project-out), ``write_shap``, then ``write_figures``
+rendered FROM THE LOPO PICKLE — and asserts the artifacts: 8 non-empty .tex
+files, reference-schema pickles covering all 216 configs, and a ledger
+checkpoint exercised mid-sweep (the stratified sweep is started, abandoned
+after a slice, and resumed; resumed configs must not recompute).
+
+Reference chain: experiment.py:493-530 (scores/shap verbs) + :634-690
+(figures). Sizes are env-tunable; defaults keep the run in tens of minutes
+on the 8-device virtual CPU mesh (this proves the verbs chain and ledger at
+full GRID size — per-config production N is dryrun_multichip's job, and the
+per-config timing evidence is the bench's).
+
+    python tools/northstar_e2e.py [workdir]
+
+Self-provisions its 8-device virtual CPU mesh via one re-exec (same recipe
+as __graft_entry__.dryrun_multichip: the device-count flag and the axon
+tunnel hook must be settled before jax initializes — with the hook active
+and the relay down, backend init hangs forever).
+
+Appends one JSON line per stage to <workdir>/northstar.jsonl and prints a
+final summary line; exits nonzero on any failed assertion.
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_TESTS = int(os.environ.get("F16_NS_N", "400"))
+N_TREES = int(os.environ.get("F16_NS_TREES", "16"))
+MAX_DEPTH = int(os.environ.get("F16_NS_DEPTH", "16"))
+
+
+def main():
+    if os.environ.get("_F16_NS_CHILD") != "1":
+        env = dict(os.environ)
+        env["_F16_NS_CHILD"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""  # empty disables the tunnel hook
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8")
+        sys.exit(subprocess.run([sys.executable, os.path.abspath(__file__),
+                                 *sys.argv[1:]], env=env).returncode)
+    workdir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        REPO, "_scratch", "northstar")
+    os.makedirs(workdir, exist_ok=True)
+    os.chdir(workdir)
+    log_path = os.path.join(workdir, "northstar.jsonl")
+
+    def log(**kw):
+        with open(log_path, "a") as fd:
+            fd.write(json.dumps(kw) + "\n")
+        print(json.dumps(kw), flush=True)
+
+    import jax
+
+    from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.figures.report import write_figures
+    from flake16_framework_tpu.pipeline import write_scores, write_shap
+    from flake16_framework_tpu.runner.subjects import iter_subjects
+    from flake16_framework_tpu.utils.synth import make_tests_json
+
+    n_dev = len(jax.devices())
+    subjects = list(iter_subjects())
+    names = [s.name for s in subjects]
+    make_tests_json("tests.json", n_tests=N_TESTS, n_projects=26, seed=11,
+                    names=names)
+    grid = list(cfg.iter_config_keys())
+    assert len(grid) == 216
+    tiny = {"Extra Trees": N_TREES, "Random Forest": N_TREES}
+    log(stage="setup", n_tests=N_TESTS, n_trees=N_TREES, devices=n_dev)
+
+    # --- stratified scores: slice first (mid-sweep checkpoint), resume ----
+    t0 = time.time()
+    write_scores(configs=grid[:24], max_depth=MAX_DEPTH, tree_overrides=tiny,
+                 checkpoint_every=12)
+    t_slice = time.time() - t0
+    with open("scores.pkl", "rb") as fd:
+        assert len(pickle.load(fd)) == 24
+    t0 = time.time()
+    scores = write_scores(max_depth=MAX_DEPTH, tree_overrides=tiny,
+                          checkpoint_every=48)
+    t_strat = time.time() - t0
+    assert set(scores) == set(grid)
+    # ledger resume: re-running the full grid must be a pure cache read
+    t0 = time.time()
+    write_scores(max_depth=MAX_DEPTH, tree_overrides=tiny)
+    t_resume = time.time() - t0
+    assert t_resume < max(30.0, 0.05 * t_strat), t_resume
+    log(stage="scores_stratified", slice_s=round(t_slice, 1),
+        full_s=round(t_strat, 1), resume_s=round(t_resume, 1))
+
+    # --- LOPO scores: the north star's 26-fold CV over the full grid ------
+    t0 = time.time()
+    lopo = write_scores(cv="lopo", max_depth=MAX_DEPTH, tree_overrides=tiny,
+                        checkpoint_every=48)
+    t_lopo = time.time() - t0
+    assert set(lopo) == set(grid)
+    with open("scores-lopo.pkl", "rb") as fd:
+        on_disk = pickle.load(fd)
+    assert set(on_disk) == set(grid)
+    n_scored = sum(v[3][-1] is not None for v in lopo.values())
+    log(stage="scores_lopo", full_s=round(t_lopo, 1), scored_f1=n_scored)
+
+    # --- shap + figures FROM THE LOPO PICKLE ------------------------------
+    t0 = time.time()
+    shap_vals = write_shap(max_depth=MAX_DEPTH, tree_overrides=tiny)
+    t_shap = time.time() - t0
+    assert all(v.shape == (N_TESTS, 16) for v in shap_vals)
+    write_figures(scores_file="scores-lopo.pkl", subjects=subjects,
+                  star_fetch=lambda repo: {})
+    arts = ("tests.tex", "req-runs.tex", "corr.tex", "nod-top.tex",
+            "od-top.tex", "nod-comp.tex", "od-comp.tex", "shap.tex")
+    for name in arts:
+        assert os.path.exists(name), name
+        assert open(name).read().strip(), name
+    log(stage="shap_figures", shap_s=round(t_shap, 1), artifacts=len(arts))
+
+    log(stage="done", ok=True,
+        total_s=round(t_slice + t_strat + t_lopo + t_shap, 1))
+
+
+if __name__ == "__main__":
+    main()
