@@ -123,14 +123,33 @@ def run_step(name, timeout, env_extra=None, tag=None):
 def tune_hist():
     """Sweep the hist-grower knobs over the chunk-fit step, one subprocess
     per combo (the knobs are read at import). Stops the sweep if a combo
-    fails (tunnel state unknown)."""
-    for bins in (32, 64):
-        for bw in (64, 128, 256):
+    fails (tunnel state unknown). Widths are results-neutral (per-node RNG
+    keys derive from node ids), so any winner ships without a parity
+    re-check; bins stay at 64 — 32 was rejected by the F1 parity data
+    (PROFILE.md) and re-enters only with the full-tier harness attached."""
+    for bw in (64, 128, 256, 512):
+        ok = run_step(
+            "rf_chunk", 600,
+            env_extra={"F16_HIST_NODE_BATCH": str(bw)},
+            tag=f"rf_chunk_w{bw}",
+        )
+        if not ok:
+            return False
+    return True
+
+
+def tune_shap():
+    """Sweep the Pallas Tree SHAP kernel's block shapes over the shap step
+    (VERDICT r2: block occupancy never traced on device; the steady 12.79 s
+    cfg0 fragment is the stage most at risk against the compiled single-
+    host baseline)."""
+    for sblk in (128, 256, 512):
+        for lblk in (8, 16, 32):
             ok = run_step(
-                "rf_chunk", 600,
-                env_extra={"F16_HIST_BINS": str(bins),
-                           "F16_HIST_NODE_BATCH": str(bw)},
-                tag=f"rf_chunk_b{bins}_w{bw}",
+                "shap", 600,
+                env_extra={"F16_SHAP_SBLK": str(sblk),
+                           "F16_SHAP_LBLK": str(lblk)},
+                tag=f"shap_s{sblk}_l{lblk}",
             )
             if not ok:
                 return False
@@ -140,15 +159,16 @@ def tune_hist():
 def main():
     steps = sys.argv[1:] or ["matmul", "dt", "rf_chunk", "rf_full",
                              "et_full", "shap", "shap_equiv", "predict_ab"]
-    unknown = [s for s in steps if s not in STEP_SRC and s != "tune_hist"]
+    tuners = {"tune_hist": tune_hist, "tune_shap": tune_shap}
+    unknown = [s for s in steps if s not in STEP_SRC and s not in tuners]
     if unknown:
         sys.exit(f"unknown step(s) {unknown}; known: "
-                 f"{sorted(STEP_SRC) + ['tune_hist']}")
+                 f"{sorted(STEP_SRC) + sorted(tuners)}")
     timeouts = {"matmul": 120, "dt": 420}
     for name in steps:
-        if name == "tune_hist":
-            if not tune_hist():
-                print("tune_hist aborted — stopping", file=sys.stderr)
+        if name in tuners:
+            if not tuners[name]():
+                print(f"{name} aborted — stopping", file=sys.stderr)
                 break
             continue
         ok = run_step(name, timeouts.get(name, 600))

@@ -1,0 +1,183 @@
+"""Multi-HOST sweep dryrun: the DCN-analog path over jax.distributed.
+
+The single-host story is covered by ``dryrun_multichip`` (8 virtual devices
+in one process = one host's ICI domain). This tool proves the sweep's
+sharded program also runs when the "config" mesh axis spans PROCESSES — the
+topology a real multi-host TPU pod presents (reference analog: the sweep's
+``multiprocessing.Pool`` fan-out, experiment.py:493-498, which shares
+nothing but the filesystem; here the processes form one SPMD program over
+the jax.distributed coordination service).
+
+    python tools/multihost_dryrun.py            # parent: spawns everything
+
+Parent spawns:
+  1. a 2-process x 4-virtual-device-each GLOBAL mesh run (coordinator on
+     localhost; each process holds 4 of the 8 shards) of one 8-config
+     Extra Trees batch through make_sharded_cv_fns — inputs placed with
+     jax.make_array_from_process_local_data, per-config confusion counts
+     gathered by an XLA resharding identity (cross-process all-gather);
+  2. a single-process 8-virtual-device run of the SAME batch (the
+     dryrun_multichip topology).
+Counts must match EXACTLY (the program is deterministic and shard_map
+semantics are topology-independent); the parent asserts bit-equality and
+prints one JSON line. Appends the result to _scratch/multihost.jsonl.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+COORD = "127.0.0.1:12765"
+N_TESTS = int(os.environ.get("F16_MH_N", "300"))
+N_TREES = int(os.environ.get("F16_MH_TREES", "16"))
+N_PROJECTS = 6
+N_FOLDS = 4
+B = 8  # config batch
+
+
+def child(n_procs, pid):
+    import numpy as np
+
+    if n_procs > 1:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=COORD, num_processes=n_procs, process_id=pid
+        )
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.parallel.folds import fold_masks
+    from flake16_framework_tpu.parallel.sweep import make_sharded_cv_fns
+    from flake16_framework_tpu.constants import FLAKY
+    from flake16_framework_tpu.utils.synth import make_dataset
+
+    devices = jax.devices()
+    assert len(devices) == 8, len(devices)
+    mesh = Mesh(np.array(devices), ("config",))
+
+    # Same deterministic inputs in every process (seeded synth).
+    feats, labels, pids_arr = make_dataset(
+        n_tests=N_TESTS, n_projects=N_PROJECTS, seed=9
+    )
+    feats = feats.astype(np.float32)
+    n, nf = feats.shape
+
+    fl_names = ["NOD", "OD"]
+    preps = ["None", "Scaling", "PCA"]
+    bals = ["None", "SMOTE", "Tomek Links", "SMOTE ENN"]
+    configs = [(fl_names[i % 2], preps[i % 3], bals[i % 4]) for i in range(B)]
+    fls = np.array([cfg.FLAKY_TYPES[c[0]] for c in configs], np.int32)
+    prs = np.array([cfg.PREPROCESSINGS[c[1]] for c in configs], np.int32)
+    bls = np.array([cfg.BALANCINGS[c[2]] for c in configs], np.int32)
+    keys = np.stack([
+        np.asarray(jax.random.fold_in(jax.random.PRNGKey(0), i))
+        for i in range(B)
+    ])
+    masks = {}
+    for fl in np.unique(fls):
+        y = labels == fl
+        masks[int(fl)] = fold_masks(y, n_splits=N_FOLDS)
+    trms = np.stack([masks[int(f)][0] for f in fls])
+    tems = np.stack([masks[int(f)][1] for f in fls])
+
+    spec = cfg.ModelSpec("Extra Trees", N_TREES, False, True, True)
+    fit_b, score_b, *_ = make_sharded_cv_fns(
+        spec, mesh, n=n, n_feat=nf, n_projects=N_PROJECTS, max_depth=12,
+        n_folds=N_FOLDS,
+    )
+
+    def put(arr, spec_):
+        # make_array_from_process_local_data takes THIS process's portion:
+        # the full array for replicated specs, only our config rows for
+        # batch-sharded ones (process-major device order = config order)
+        sh = NamedSharding(mesh, spec_)
+        arr = np.asarray(arr)
+        if spec_ != P() and n_procs > 1:
+            per = arr.shape[0] // n_procs
+            arr = arr[pid * per:(pid + 1) * per]
+        return jax.make_array_from_process_local_data(sh, arr)
+
+    rep, shd = P(), P("config")
+    args = (put(feats, rep), put(labels.astype(np.int32), rep),
+            put(fls, shd), put(prs, shd), put(bls, shd),
+            put(keys, shd), put(trms, shd))
+    t0 = time.time()
+    forest, xp, yv = fit_b(*args)
+    counts = score_b(forest, xp, yv, put(tems, shd),
+                     put(pids_arr.astype(np.int32), rep))
+    # global sharded [B, P, 3] -> replicated via an XLA resharding identity
+    # (the cross-process all-gather rides the distributed backend, the
+    # DCN-analog collective), then any process reads the full batch off
+    # its first addressable shard
+    rep_sh = NamedSharding(mesh, P())
+    counts = jax.jit(lambda c: c, out_shardings=rep_sh)(counts)
+    counts = np.asarray(counts.addressable_data(0))
+    wall = time.time() - t0
+    if pid == 0:
+        out = os.environ["F16_MH_OUT"]
+        np.save(out, counts)
+        print(json.dumps({"procs": n_procs, "wall_s": round(wall, 1),
+                          "counts_shape": list(counts.shape)}), flush=True)
+
+
+def parent():
+    here = os.path.abspath(__file__)
+    scratch = os.path.join(REPO, "_scratch")
+    os.makedirs(scratch, exist_ok=True)
+
+    def env_for(n_procs, pid, out):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",  # never touch the tunnel
+            "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count="
+                          + ("4" if n_procs > 1 else "8")),
+            "F16_MH_OUT": out,
+        })
+        return env
+
+    multi_out = os.path.join(scratch, "mh_multi.npy")
+    single_out = os.path.join(scratch, "mh_single.npy")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, here, "--child", "2", str(pid)],
+            env=env_for(2, pid, multi_out), cwd=REPO,
+        )
+        for pid in range(2)
+    ]
+    try:
+        rcs = [p.wait(timeout=900) for p in procs]
+    finally:
+        for p in procs:  # a wedged sibling would keep holding COORD's port
+            if p.poll() is None:
+                p.kill()
+    assert rcs == [0, 0], rcs
+    r = subprocess.run([sys.executable, here, "--child", "1", "0"],
+                       env=env_for(1, 0, single_out), cwd=REPO, timeout=900)
+    assert r.returncode == 0
+
+    import numpy as np
+
+    a, b = np.load(multi_out), np.load(single_out)
+    ok = a.shape == b.shape and bool((a == b).all())
+    line = {"multihost_dryrun_ok": ok, "procs": 2, "devices_per_proc": 4,
+            "batch": B, "n": N_TESTS, "trees": N_TREES}
+    with open(os.path.join(scratch, "multihost.jsonl"), "a") as fd:
+        fd.write(json.dumps(line) + "\n")
+    print(json.dumps(line))
+    assert ok, "multi-process counts differ from single-process"
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(int(sys.argv[2]), int(sys.argv[3]))
+    else:
+        parent()

@@ -1,0 +1,153 @@
+"""Tunnel recovery watcher: poll for the relay listener, then run the
+hardware chain automatically.
+
+The TPU is reached through a local relay whose host side can die under
+long dispatches and never self-heals (PROFILE.md round-2 post-mortem);
+only the infra can restart it. Every up-minute is bench time, so this
+watcher turns recovery into results without a human in the loop:
+
+    python tools/recovery_watch.py          # poll forever, chain on recovery
+    python tools/recovery_watch.py --once   # single liveness check, exit 0/1
+
+Chain on recovery (each stage bounded, logged to _scratch/watcher_r03.log):
+  1. hw_probe matmul           — cheap end-to-end device check (also
+                                 catches a listener with a dead upstream)
+  2. hw_probe full stages      — per-stage timings, pre-warms .jax_cache
+  3. bench.py                  — headline JSON -> _scratch/bench_tpu.json
+  4. parity.py --full          — PARITY.json at repo root (±0.01 criterion)
+  5. hw_probe tune_hist        — knob sweep, results-neutral since the
+                                 per-node RNG keys derive from node ids
+
+A stage that fails with the tunnel down again returns the watcher to
+polling; a completed chain exits. Liveness check is `ss -tln` — NEVER a
+jax import: any jax process hangs forever at backend init when the relay
+is down (claim-retry loop), while `ss` is free.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from flake16_framework_tpu.utils.relay import (  # noqa: E402
+    RELAY_PORT as PORT, relay_listener_up,
+)
+
+LOG = os.path.join(REPO, "_scratch", "watcher_r03.log")
+STATUS = os.path.join(REPO, "_scratch", "watcher_status.json")
+
+
+def log(msg):
+    line = "%s %s" % (time.strftime("%H:%M:%S"), msg)
+    os.makedirs(os.path.dirname(LOG), exist_ok=True)
+    with open(LOG, "a") as fd:
+        fd.write(line + "\n")
+    print(line, flush=True)
+
+
+def set_status(**kw):
+    kw["t"] = time.strftime("%H:%M:%S")
+    with open(STATUS, "w") as fd:
+        json.dump(kw, fd)
+
+
+def listener_up():
+    return relay_listener_up() is True
+
+
+def run_stage(name, cmd, timeout, env_extra=None):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    log("stage %s: %s" % (name, " ".join(cmd)))
+    set_status(state="running", stage=name)
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True, cwd=REPO, env=env)
+        ok = r.returncode == 0
+        log("stage %s %s in %.0fs" % (name, "ok" if ok else
+                                      "FAILED rc=%d" % r.returncode,
+                                      time.time() - t0))
+        if not ok:
+            log("  stderr tail: " + (r.stderr or "")[-300:].replace("\n", " | "))
+        return ok, r.stdout
+    except subprocess.TimeoutExpired:
+        log("stage %s TIMEOUT after %ds" % (name, timeout))
+        return False, ""
+
+
+def chain():
+    """The recovery chain. Returns True when it ran to completion."""
+    py = sys.executable
+    probe = os.path.join(REPO, "tools", "hw_probe.py")
+
+    ok, _ = run_stage("matmul", [py, probe, "matmul"], 180)
+    if not ok:
+        return False
+    ok, _ = run_stage("probe_all", [py, probe, "dt", "rf_chunk", "rf_full",
+                                    "et_full", "shap", "shap_equiv",
+                                    "predict_ab"], 3600)
+    # bench even if one probe stage failed: stages are independent and the
+    # bench has its own probe + fallback protocol.
+    ok_b, out = run_stage("bench", [py, os.path.join(REPO, "bench.py")], 2700)
+    lines = out.strip().splitlines() if out else []
+    if lines:
+        try:  # only persist a parseable result line — a failed bench's
+            # stdout tail must not clobber a previous good record
+            json.loads(lines[-1])
+        except ValueError:
+            pass
+        else:
+            with open(os.path.join(REPO, "_scratch", "bench_tpu.json"),
+                      "w") as fd:
+                fd.write(lines[-1] + "\n")
+    if not ok_b and not listener_up():
+        return False
+    ok_p, _ = run_stage(
+        "parity_full", [py, os.path.join(REPO, "parity.py"), "--full"], 5400,
+        env_extra={"PARITY_SKLEARN_CACHE": os.path.join(
+            REPO, "parity_sklearn_n4000_t100.json")},
+    )
+    run_stage("tune", [py, probe, "tune_hist", "tune_shap"], 9000)
+    set_status(state="done", bench_ok=ok_b, parity_ok=ok_p)
+    return True
+
+
+def main():
+    if "--once" in sys.argv:
+        up = listener_up()
+        print(json.dumps({"listener_up": up}))
+        sys.exit(0 if up else 1)
+    log("watcher armed (poll %s every 60s)" % PORT)
+    set_status(state="polling")
+    fails = 0
+    beat = 0
+    while True:
+        if listener_up():
+            # level-triggered with backoff, not edge-triggered: a listener
+            # with a dead upstream (chain aborts at the matmul probe) must
+            # be retried while it stays up, or a later real recovery that
+            # never bounces the listener would produce no results.
+            log("listener UP — settling 15s, then chain (attempt %d)"
+                % (fails + 1))
+            time.sleep(15)
+            if chain():
+                log("chain complete — watcher exiting")
+                return
+            fails += 1
+            backoff = min(60 * 2 ** fails, 1800)
+            log("chain aborted — re-polling, next attempt in >=%ds" % backoff)
+            set_status(state="polling", chain_fails=fails)
+            time.sleep(backoff)
+        elif beat % 10 == 0:
+            set_status(state="polling", chain_fails=fails)
+        beat += 1
+        time.sleep(60)
+
+
+if __name__ == "__main__":
+    main()
