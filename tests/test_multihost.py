@@ -1,0 +1,41 @@
+"""Multi-process (DCN-analog) sweep dryrun, suite-sized.
+
+Pins tools/multihost_dryrun.py's contract: the sharded sweep program over
+a global mesh spanning two jax.distributed processes must produce
+bit-identical per-config confusion counts to the single-process mesh
+(SURVEY.md §5 distributed backend — the reference's Pool fan-out analog).
+Runs the tool's parent entry in a subprocess at reduced env-knob sizes."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_multihost_dryrun_small():
+    import signal
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # children set their own JAX env
+    env["F16_MH_N"] = "150"
+    env["F16_MH_TREES"] = "8"
+    # Own process group + killpg on timeout: a SIGKILLed parent would skip
+    # its finally-block and orphan the two jax.distributed children, which
+    # keep the fixed coordinator port bound for every later run.
+    p = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "multihost_dryrun.py")],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, start_new_session=True,
+    )
+    try:
+        out, err = p.communicate(timeout=420)
+    except subprocess.TimeoutExpired:
+        os.killpg(p.pid, signal.SIGKILL)
+        p.wait()
+        raise
+    assert p.returncode == 0, (out[-500:], err[-800:])
+    line = json.loads(out.strip().splitlines()[-1])
+    assert line["multihost_dryrun_ok"] is True
+    assert line["procs"] == 2

@@ -135,6 +135,21 @@ def tune_hist():
         )
         if not ok:
             return False
+    # Dispatch-size arm: the per-tree rate from a small chunk conflates
+    # per-dispatch overhead (tunnel RTT + launch) with compute; timing the
+    # SAME fit at several chunk widths separates them — the >=20x budget
+    # (PROFILE.md) hinges on big chunks amortizing the overhead while
+    # staying inside the fault envelope. (dc=25 is the width loop's
+    # rf_chunk_w128 — BENCH_DISPATCH_TREES defaults to 25 — so only the
+    # ends of the range need their own runs.)
+    for dc in (2, 50):
+        ok = run_step(
+            "rf_chunk", 600,
+            env_extra={"BENCH_DISPATCH_TREES": str(dc)},
+            tag=f"rf_chunk_d{dc}",
+        )
+        if not ok:
+            return False
     return True
 
 

@@ -60,24 +60,38 @@ def listener_up():
 
 
 def run_stage(name, cmd, timeout, env_extra=None):
+    import signal
+
     env = dict(os.environ)
     env.update(env_extra or {})
     log("stage %s: %s" % (name, " ".join(cmd)))
     set_status(state="running", stage=name)
     t0 = time.time()
+    # Own process group + killpg on timeout: a stage like hw_probe spawns
+    # per-step children, and an orphaned step would keep a live TPU
+    # dispatch running against the fragile tunnel after the watcher has
+    # moved on.
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, cwd=REPO, env=env,
+                         start_new_session=True)
     try:
-        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
-                           text=True, cwd=REPO, env=env)
-        ok = r.returncode == 0
-        log("stage %s %s in %.0fs" % (name, "ok" if ok else
-                                      "FAILED rc=%d" % r.returncode,
-                                      time.time() - t0))
-        if not ok:
-            log("  stderr tail: " + (r.stderr or "")[-300:].replace("\n", " | "))
-        return ok, r.stdout
+        out, err = p.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
-        log("stage %s TIMEOUT after %ds" % (name, timeout))
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        p.wait()
+        log("stage %s TIMEOUT after %ds (process group killed)"
+            % (name, timeout))
         return False, ""
+    ok = p.returncode == 0
+    log("stage %s %s in %.0fs" % (name, "ok" if ok else
+                                  "FAILED rc=%d" % p.returncode,
+                                  time.time() - t0))
+    if not ok:
+        log("  stderr tail: " + (err or "")[-300:].replace("\n", " | "))
+    return ok, out
 
 
 def chain():
@@ -112,7 +126,8 @@ def chain():
         env_extra={"PARITY_SKLEARN_CACHE": os.path.join(
             REPO, "parity_sklearn_n4000_t100.json")},
     )
-    run_stage("tune", [py, probe, "tune_hist", "tune_shap"], 9000)
+    # 6 tune_hist + 9 tune_shap combos x 600 s worst case each, plus slack
+    run_stage("tune", [py, probe, "tune_hist", "tune_shap"], 12600)
     set_status(state="done", bench_ok=ok_b, parity_ok=ok_p)
     return True
 
