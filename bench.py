@@ -271,11 +271,14 @@ def worker(n_tests, n_trees):
         )
     t_scores = time.time() - t0
 
-    # SHAP stage (auto impl: the Pallas kernel on TPU, XLA elsewhere).
+    # SHAP stage. Default impl "auto" = the Pallas kernel on TPU, XLA
+    # elsewhere; BENCH_SHAP_IMPL overrides so a hardware A/B (hw_probe
+    # tune_shap's xla arm) can ship its winner without a code change.
     n_explain = min(SHAP_EXPLAIN, n_tests)
     shap_kw = dict(tree_overrides=overrides, n_explain=n_explain,
                    shap_tree_chunk=DISPATCH_TREES,
-                   fit_dispatch_trees=DISPATCH_TREES)
+                   fit_dispatch_trees=DISPATCH_TREES,
+                   impl=os.environ.get("BENCH_SHAP_IMPL", "auto"))
     for keys in cfg.SHAP_CONFIGS:  # warm-up compile per config
         pipeline.shap_for_config(keys, feats, labels, **shap_kw)
         print(f"warmed shap {keys[4]}", file=sys.stderr, flush=True)

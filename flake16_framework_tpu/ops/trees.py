@@ -873,21 +873,24 @@ def predict_proba(forest, x, impl=None):
     """Mean of per-tree leaf class distributions (sklearn soft vote:
     ensemble predict_proba averages per-tree normalized leaf counts).
 
-    Two traversal formulations, chosen by backend at trace time (``impl``
-    overrides: "gather"/"windows"):
+    Two traversal formulations (``impl`` overrides: "gather"/"windows"):
 
-    - "gather" — classic per-level node-table lookups; fast on CPU, but
-      TPUs serialize gathers (~70 M elem/s measured, PROFILE.md), making
-      5*S*depth*instances lookups the predict bottleneck at bench sizes.
+    - "gather" — classic per-level node-table lookups. The default on
+      every backend: the one at-size device A/B on record (hw_probe
+      predict_ab, N=2000: gather 1 ms vs windows 5 ms steady) has it
+      winning on the TPU too — at these table sizes the serialized-gather
+      penalty (~70 M elem/s, PROFILE.md) is smaller than the windows
+      formulation's re-entry overhead.
     - "windows" — sweep fixed node-id windows [k*W, (k+1)*W): per window,
       one [S,F]@[F,W] one-hot feature-select matmul + comparison table,
       then an inner loop routes resident samples (re-entered while any
       sample can still descend inside the window — node ids are monotone
       parent->child for both growers, so a forward sweep visits every
-      path). No per-sample gathers except the final leaf-value read.
+      path). No per-sample gathers except the final leaf-value read; the
+      MXU-riding fallback if bigger forests ever flip the A/B.
     """
     if impl is None:
-        impl = "gather" if jax.default_backend() == "cpu" else "windows"
+        impl = os.environ.get("F16_PREDICT_IMPL", "gather")
     s = x.shape[0]
     depth = jnp.max(forest.max_depth)  # scalar even if forests were stacked
 

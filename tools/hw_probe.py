@@ -157,7 +157,8 @@ def tune_shap():
     """Sweep the Pallas Tree SHAP kernel's block shapes over the shap step
     (VERDICT r2: block occupancy never traced on device; the steady 12.79 s
     cfg0 fragment is the stage most at risk against the compiled single-
-    host baseline)."""
+    host baseline). Ends with an XLA-formulation arm — if XLA beats the
+    kernel at every block shape, the bench ships it via BENCH_SHAP_IMPL."""
     for sblk in (128, 256, 512):
         for lblk in (8, 16, 32):
             ok = run_step(
@@ -168,7 +169,8 @@ def tune_shap():
             )
             if not ok:
                 return False
-    return True
+    return run_step("shap", 600, env_extra={"BENCH_SHAP_IMPL": "xla"},
+                    tag="shap_xla")
 
 
 def main():

@@ -85,7 +85,8 @@ def shap_times():
     overrides = {"Random Forest": N_TREES, "Extra Trees": N_TREES}
     keys = cfg.SHAP_CONFIGS[0]
     kw = dict(tree_overrides=overrides, n_explain=N_EXPLAIN,
-              shap_tree_chunk=DISPATCH, fit_dispatch_trees=DISPATCH)
+              shap_tree_chunk=DISPATCH, fit_dispatch_trees=DISPATCH,
+              impl=os.environ.get("BENCH_SHAP_IMPL", "auto"))
     t0 = time.time()
     pipeline.shap_for_config(keys, feats, labels, **kw)
     yield f"shap_cfg0_compile_s {time.time() - t0:.2f}"
