@@ -1,0 +1,69 @@
+"""Recovery-watcher logic that must not regress silently: the tune-winner
+parser that decides the knobs for the unattended tuned re-bench."""
+
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "recovery_watch", os.path.join(REPO, "tools", "recovery_watch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_pick_tuned_env(tmp_path, monkeypatch):
+    rw = _load()
+    monkeypatch.setattr(rw, "REPO", str(tmp_path))
+    (tmp_path / "_scratch").mkdir()
+    lines = [
+        # pre-existing content the parser must skip via since_pos
+        {"step": "rf_chunk_w64", "ok": True,
+         "out": ["chunk_steady_s 0.01 (25 trees x 10 folds)"]},
+    ]
+    tail = [
+        {"step": "rf_chunk_w128", "ok": True,
+         "out": ["chunk_steady_s 0.40 (25 trees x 10 folds)"]},
+        {"step": "rf_chunk_w512", "ok": True,
+         "out": ["chunk_steady_s 0.30 (25 trees x 10 folds)"]},
+        {"step": "rf_chunk_d2", "ok": True,
+         "out": ["chunk_steady_s 0.10 (2 trees x 10 folds)"]},
+        {"step": "rf_chunk_d50", "ok": True,
+         "out": ["chunk_steady_s 0.60 (50 trees x 10 folds)"]},
+        {"step": "shap_s128_l8", "ok": True,
+         "out": ["shap_cfg0_steady_s 9.0"]},
+        {"step": "shap_s512_l32", "ok": True,
+         "out": ["shap_cfg0_steady_s 4.0"]},
+        {"step": "shap_xla", "ok": True, "out": ["shap_cfg0_steady_s 5.0"]},
+        # non-tune steps and failures must be ignored
+        {"step": "shap_equiv", "ok": True,
+         "out": ["pallas_vs_xla_maxabs 1e-8 OK"]},
+        {"step": "rf_chunk_w256", "ok": False,
+         "out": ["chunk_steady_s 0.01 (25 trees x 10 folds)"]},
+    ]
+    path = tmp_path / "_scratch" / "hw_probe.jsonl"
+    with open(path, "w") as fd:
+        for rec in lines:
+            fd.write(json.dumps(rec) + "\n")
+    pos = path.stat().st_size
+    with open(path, "a") as fd:
+        for rec in tail:
+            fd.write(json.dumps(rec) + "\n")
+
+    assert rw.pick_tuned_env(pos) == {
+        "F16_HIST_NODE_BATCH": "512",   # lowest per-tree steady in window
+        "BENCH_DISPATCH_TREES": "50",   # 0.60/50 beats 0.10/2
+        "F16_SHAP_SBLK": "512", "F16_SHAP_LBLK": "32",  # beats xla arm
+    }
+    # xla arm winning selects the impl override instead of block knobs
+    with open(path, "a") as fd:
+        fd.write(json.dumps(
+            {"step": "shap_xla", "ok": True,
+             "out": ["shap_cfg0_steady_s 1.0"]}) + "\n")
+    assert rw.pick_tuned_env(pos)["BENCH_SHAP_IMPL"] == "xla"
+    # nothing parseable in the window -> empty env, not a crash
+    assert rw.pick_tuned_env(path.stat().st_size) == {}
