@@ -1,0 +1,113 @@
+"""Property-based invariants for the tree kernels (hypothesis).
+
+The parity suites pin behavior against sklearn on fixed datasets; these
+pin STRUCTURAL invariants on randomized inputs — the class of bug a fixed
+dataset can miss (degenerate columns, heavy ties, tiny minorities).
+
+Shapes are FIXED across examples (only values and seeds vary) so every
+example after the first hits the jit cache; example counts are bounded to
+keep the suite's wall-clock budget."""
+
+import jax
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from flake16_framework_tpu.ops.trees import (
+    fit_forest, fit_forest_hist, predict_proba,
+)
+
+N, F = 120, 6
+SETTINGS = dict(max_examples=15, deadline=None)
+
+
+def _data(values_seed, *, ties):
+    rng = np.random.RandomState(values_seed)
+    x = rng.randn(N, F).astype(np.float32)
+    if ties:  # quantize to force equal values / constant-ish columns
+        x = np.round(x * 2) / 2
+        x[:, 0] = x[0, 0]  # one fully constant feature
+    y = (x[:, 1] + 0.5 * rng.randn(N)) > 0
+    if y.all() or not y.any():
+        y[0] = not y[0]
+    w = np.ones(N, np.float32)
+    return x, y, w
+
+
+@st.composite
+def fit_case(draw):
+    return (draw(st.integers(0, 10 ** 6)),          # data seed
+            draw(st.integers(0, 10 ** 6)),          # fit key
+            draw(st.booleans()),                    # ties
+            draw(st.booleans()),                    # bootstrap
+            draw(st.booleans()))                    # random_splits (ET)
+
+
+@given(fit_case())
+@settings(**SETTINGS)
+def test_hist_forest_structure_is_consistent(case):
+    seed, key, ties, bootstrap, random_splits = case
+    x, y, w = _data(seed, ties=ties)
+    f = fit_forest_hist(x, y, w, jax.random.PRNGKey(key), n_trees=3,
+                        bootstrap=bootstrap, random_splits=random_splits,
+                        sqrt_features=True, max_depth=7, max_nodes=128)
+    feat = np.asarray(f.feature)
+    left = np.asarray(f.left)
+    right = np.asarray(f.right)
+    value = np.asarray(f.value, np.float64)
+    n_nodes = np.asarray(f.n_nodes)
+    for t in range(feat.shape[0]):
+        m = int(n_nodes[t])
+        assert 1 <= m <= 128
+        internal = feat[t, :m] >= 0
+        # children exist, stay in range, and ids grow parent -> child (the
+        # BFS invariant predict's window sweep relies on)
+        ids = np.arange(m)
+        assert (left[t, :m][internal] > ids[internal]).all()
+        assert (right[t, :m][internal] == left[t, :m][internal] + 1).all()
+        assert (right[t, :m][internal] < m).all()
+        # leaves have no children
+        assert (left[t, :m][~internal] == -1).all()
+        # cover conservation: children partition the parent's weighted
+        # class counts exactly (integer-weight histogram accumulation)
+        pv = value[t, :m][internal]
+        lv = value[t][left[t, :m][internal]]
+        rv = value[t][right[t, :m][internal]]
+        np.testing.assert_allclose(lv + rv, pv, rtol=0, atol=1e-6)
+        # every node's cover is positive and the root covers all weight
+        # (bootstrap draws N integer counts, so the total is N either way)
+        assert (value[t, :m].sum(-1) > 0).all()
+        np.testing.assert_allclose(value[t, 0].sum(), float(N), atol=1e-6)
+
+
+@given(fit_case())
+@settings(**SETTINGS)
+def test_predict_impls_agree_on_random_forests(case):
+    seed, key, ties, bootstrap, random_splits = case
+    x, y, w = _data(seed, ties=ties)
+    for fit in (fit_forest_hist, fit_forest):
+        f = fit(x, y, w, jax.random.PRNGKey(key), n_trees=3,
+                bootstrap=bootstrap, random_splits=random_splits,
+                sqrt_features=True, max_depth=7, max_nodes=128)
+        a = np.asarray(predict_proba(f, x, impl="gather"))
+        b = np.asarray(predict_proba(f, x, impl="windows"))
+        np.testing.assert_array_equal(a, b)
+        s = a.sum(-1)
+        np.testing.assert_allclose(s, np.ones_like(s), atol=1e-5)
+
+
+@given(st.integers(0, 10 ** 6), st.integers(1, 5))
+@settings(**SETTINGS)
+def test_fold_masks_partition_and_stratify(seed, k_pos):
+    from flake16_framework_tpu.parallel.folds import fold_masks
+
+    rng = np.random.RandomState(seed)
+    y = np.zeros(N, bool)
+    y[rng.choice(N, size=5 * k_pos, replace=False)] = True
+    train, test = fold_masks(y, n_splits=5)
+    # every sample is in exactly one test fold, and train = complement
+    assert (test.sum(0) == 1).all()
+    np.testing.assert_array_equal(train + test, np.ones_like(train))
+    # stratification: each fold's positive count within 1 of the ideal
+    per_fold = (test * y[None, :]).sum(1)
+    ideal = y.sum() / 5
+    assert (np.abs(per_fold - ideal) <= 1).all()

@@ -65,5 +65,12 @@ def test_pick_tuned_env(tmp_path, monkeypatch):
             {"step": "shap_xla", "ok": True,
              "out": ["shap_cfg0_steady_s 1.0"]}) + "\n")
     assert rw.pick_tuned_env(pos)["BENCH_SHAP_IMPL"] == "xla"
+    # the w128 run is the dc=25 midpoint of the dispatch sweep: when its
+    # per-tree rate beats both end arms, the default dispatch must win
+    with open(path, "a") as fd:
+        fd.write(json.dumps(
+            {"step": "rf_chunk_w128", "ok": True,
+             "out": ["chunk_steady_s 0.25 (25 trees x 10 folds)"]}) + "\n")
+    assert rw.pick_tuned_env(pos)["BENCH_DISPATCH_TREES"] == "25"
     # nothing parseable in the window -> empty env, not a crash
     assert rw.pick_tuned_env(path.stat().st_size) == {}

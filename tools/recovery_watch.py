@@ -135,6 +135,13 @@ def pick_tuned_env(since_pos):
                     if tag.startswith("rf_chunk_w"):
                         consider("width", per_tree,
                                  {"F16_HIST_NODE_BATCH": tag.rsplit("w", 1)[1]})
+                        if tag == "rf_chunk_w128":
+                            # the width loop's w128 run IS the dc=25
+                            # midpoint of the dispatch sweep (hw_probe
+                            # tune_hist) — without it the end arms d2/d50
+                            # would win even when the default 25 is best
+                            consider("dispatch", per_tree,
+                                     {"BENCH_DISPATCH_TREES": "25"})
                     else:
                         consider("dispatch", per_tree,
                                  {"BENCH_DISPATCH_TREES": tag.rsplit("d", 1)[1]})
@@ -174,18 +181,21 @@ def chain():
                                     "predict_ab"], 3600)
     # bench even if one probe stage failed: stages are independent and the
     # bench has its own probe + fallback protocol.
-    ok_b, out = run_stage("bench", [py, os.path.join(REPO, "bench.py")], 2700)
-    lines = out.strip().splitlines() if out else []
-    if lines:
-        try:  # only persist a parseable result line — a failed bench's
-            # stdout tail must not clobber a previous good record
+    def persist_bench_json(out, filename):
+        # only persist a parseable result line — a failed bench's stdout
+        # tail must not clobber a previous good record
+        lines = out.strip().splitlines() if out else []
+        if not lines:
+            return
+        try:
             json.loads(lines[-1])
         except ValueError:
-            pass
-        else:
-            with open(os.path.join(REPO, "_scratch", "bench_tpu.json"),
-                      "w") as fd:
-                fd.write(lines[-1] + "\n")
+            return
+        with open(os.path.join(REPO, "_scratch", filename), "w") as fd:
+            fd.write(lines[-1] + "\n")
+
+    ok_b, out = run_stage("bench", [py, os.path.join(REPO, "bench.py")], 2700)
+    persist_bench_json(out, "bench_tpu.json")
     if not ok_b and not listener_up():
         return False
     ok_p, _ = run_stage(
@@ -196,7 +206,10 @@ def chain():
     # 6 tune_hist + 10 tune_shap combos x 600 s worst case each, plus slack
     probe_log = os.path.join(REPO, "_scratch", "hw_probe.jsonl")
     tune_from = os.path.getsize(probe_log) if os.path.exists(probe_log) else 0
-    run_stage("tune", [py, probe, "tune_hist", "tune_shap"], 12600)
+    ok_tune, _ = run_stage("tune", [py, probe, "tune_hist", "tune_shap"],
+                           12600)
+    if not ok_tune and not listener_up():
+        return False  # tunnel died mid-sweep: poll again, retry later
 
     tuned = pick_tuned_env(tune_from)
     if tuned:
@@ -204,16 +217,9 @@ def chain():
         ok_t, out = run_stage("bench_tuned",
                               [py, os.path.join(REPO, "bench.py")], 2700,
                               env_extra=tuned)
-        lines = out.strip().splitlines() if out else []
-        if ok_t and lines:
-            try:
-                json.loads(lines[-1])
-            except ValueError:
-                pass
-            else:
-                with open(os.path.join(REPO, "_scratch",
-                                       "bench_tpu_tuned.json"), "w") as fd:
-                    fd.write(lines[-1] + "\n")
+        persist_bench_json(out, "bench_tpu_tuned.json")
+        if not ok_t and not listener_up():
+            return False
     run_stage("trace", [py, os.path.join(REPO, "tools", "hw_trace.py"),
                         "fit", "shap"], 1800, env_extra=tuned or None)
     set_status(state="done", bench_ok=ok_b, parity_ok=ok_p,
