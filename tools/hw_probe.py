@@ -108,6 +108,12 @@ def run_step(name, timeout, env_extra=None, tag=None):
             "wall_s": round(time.time() - t0, 2),
             "out": r.stdout.strip().splitlines()[-8:],
         }
+        # record what produced the numbers: a CPU-smoke entry must never
+        # read as device evidence, and tuned entries carry their knobs
+        if env.get("JAX_PLATFORMS"):
+            out["platform"] = env["JAX_PLATFORMS"]
+        if env_extra:
+            out["env"] = env_extra
         if r.returncode != 0:
             out["err"] = (r.stderr or "")[-400:]
     except subprocess.TimeoutExpired:
