@@ -95,6 +95,57 @@ def test_predict_impls_agree_on_random_forests(case):
         np.testing.assert_allclose(s, np.ones_like(s), atol=1e-5)
 
 
+@given(st.integers(0, 10 ** 6), st.integers(0, 10 ** 6),
+       st.integers(3, 30))
+@settings(**SETTINGS)
+def test_smote_balances_and_interpolates(seed, key, n_min):
+    from flake16_framework_tpu.ops.resample import smote
+
+    rng = np.random.RandomState(seed)
+    x = rng.randn(N, F).astype(np.float32)
+    y = np.zeros(N, bool)
+    y[rng.choice(N, size=n_min, replace=False)] = True
+    w = np.ones(N, np.float32)
+    cap = 2 * N
+    xs, ys, ws = (np.asarray(a) for a in smote(
+        x, y, w, jax.random.PRNGKey(key), cap))
+    assert xs.shape == (cap, F) and ws.shape == (cap,)
+    # originals untouched, weights 0/1, synthetic rows labeled minority
+    np.testing.assert_array_equal(xs[:N], x)
+    assert set(np.unique(ws)) <= {0.0, 1.0}
+    assert ys[N:].all()
+    # exact balance among valid rows
+    pos_w = ws[ys.astype(bool)].sum()
+    neg_w = ws[~ys.astype(bool)].sum()
+    assert pos_w == neg_w == N - n_min
+    # every valid synthetic point interpolates minority rows: each feature
+    # stays inside the minority class's bounding box
+    valid = ws[N:] > 0
+    if valid.any():
+        lo, hi = x[y].min(0), x[y].max(0)
+        s = xs[N:][valid]
+        assert (s >= lo - 1e-5).all() and (s <= hi + 1e-5).all()
+
+
+@given(st.integers(0, 10 ** 6), st.booleans())
+@settings(**SETTINGS)
+def test_cleaning_keeps_are_subset_and_preserve_minority(seed, use_enn):
+    from flake16_framework_tpu.ops.resample import enn_keep, tomek_keep
+
+    rng = np.random.RandomState(seed)
+    x = rng.randn(N, F).astype(np.float32)
+    y = np.zeros(N, bool)
+    y[rng.choice(N, size=20, replace=False)] = True
+    w = np.ones(N, np.float32)
+    keep = tomek_keep if not use_enn else enn_keep
+    w2 = np.asarray(keep(x, y, w, strategy_all=False))
+    # a cleaning pass only zeroes weights, never adds or grows them
+    assert w2.shape == (N,)
+    assert ((w2 == 0) | (w2 == w)).all()
+    # default strategy cleans the majority only: minority rows all survive
+    np.testing.assert_array_equal(w2[y], w[y])
+
+
 @given(st.integers(0, 10 ** 6), st.integers(1, 5))
 @settings(**SETTINGS)
 def test_fold_masks_partition_and_stratify(seed, k_pos):

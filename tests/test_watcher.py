@@ -72,5 +72,12 @@ def test_pick_tuned_env(tmp_path, monkeypatch):
             {"step": "rf_chunk_w128", "ok": True,
              "out": ["chunk_steady_s 0.25 (25 trees x 10 folds)"]}) + "\n")
     assert rw.pick_tuned_env(pos)["BENCH_DISPATCH_TREES"] == "25"
+    # a record carrying its exact knob env wins over tag re-parsing
+    with open(path, "a") as fd:
+        fd.write(json.dumps(
+            {"step": "rf_chunk_w9999", "ok": True,
+             "env": {"F16_HIST_NODE_BATCH": "192"},
+             "out": ["chunk_steady_s 0.025 (25 trees x 10 folds)"]}) + "\n")
+    assert rw.pick_tuned_env(pos)["F16_HIST_NODE_BATCH"] == "192"
     # nothing parseable in the window -> empty env, not a crash
     assert rw.pick_tuned_env(path.stat().st_size) == {}

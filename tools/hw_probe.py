@@ -92,33 +92,47 @@ for line in predict_ab():
 }
 
 
+# Every step reports the backend jax ACTUALLY initialized — authoritative
+# provenance (JAX_PLATFORMS alone can lie: an unset var with a failed TPU
+# init silently falls back to CPU, which must never read as device
+# evidence). run_step lifts the marker line into the record.
+_BACKEND_PREFIX = 'import jax; print("backend:", jax.default_backend())\n'
+
+
 def run_step(name, timeout, env_extra=None, tag=None):
     env = dict(os.environ)
     env.update(env_extra or {})
     env["PYTHONPATH"] = os.path.join(REPO, "tools") + ":" + env.get(
         "PYTHONPATH", "")
     t0 = time.time()
+    # base provenance present on EVERY record, including timeouts
+    out = {"step": tag or name}
+    if env.get("JAX_PLATFORMS"):
+        out["platform_env"] = env["JAX_PLATFORMS"]
+    if env_extra:
+        out["env"] = env_extra
     try:
         r = subprocess.run(
-            [sys.executable, "-c", STEP_SRC[name]], timeout=timeout,
+            [sys.executable, "-c", _BACKEND_PREFIX + STEP_SRC[name]],
+            timeout=timeout,
             capture_output=True, text=True, cwd=REPO, env=env,
         )
-        out = {
-            "step": tag or name, "ok": r.returncode == 0,
-            "wall_s": round(time.time() - t0, 2),
-            "out": r.stdout.strip().splitlines()[-8:],
-        }
-        # record what produced the numbers: a CPU-smoke entry must never
-        # read as device evidence, and tuned entries carry their knobs
-        if env.get("JAX_PLATFORMS"):
-            out["platform"] = env["JAX_PLATFORMS"]
-        if env_extra:
-            out["env"] = env_extra
+        lines = r.stdout.strip().splitlines()
+        for ln in lines[:2]:
+            if ln.startswith("backend: "):
+                out["platform"] = ln.split(": ", 1)[1]
+                lines.remove(ln)
+                break
+        out.update(
+            ok=r.returncode == 0,
+            wall_s=round(time.time() - t0, 2),
+            out=lines[-8:],
+        )
         if r.returncode != 0:
             out["err"] = (r.stderr or "")[-400:]
     except subprocess.TimeoutExpired:
-        out = {"step": tag or name, "ok": False, "timeout_s": timeout,
-               "wall_s": round(time.time() - t0, 2)}
+        out.update(ok=False, timeout_s=timeout,
+                   wall_s=round(time.time() - t0, 2))
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as fd:
         fd.write(json.dumps(out) + "\n")

@@ -124,6 +124,10 @@ def pick_tuned_env(since_pos):
                 tag, out = rec.get("step", ""), " ".join(rec.get("out", []))
                 if not rec.get("ok"):
                     continue
+                # the exact knob fragment the combo ran under is recorded
+                # in the entry itself (run_step "env"); tag parsing is the
+                # fallback for legacy records only
+                env_frag = rec.get("env")
                 if tag.startswith("rf_chunk_w") or tag.startswith(
                         "rf_chunk_d"):
                     try:  # "chunk_steady_s X (c trees x f folds)"
@@ -133,7 +137,7 @@ def pick_tuned_env(since_pos):
                         continue
                     per_tree = steady / max(c, 1)
                     if tag.startswith("rf_chunk_w"):
-                        consider("width", per_tree,
+                        consider("width", per_tree, env_frag or
                                  {"F16_HIST_NODE_BATCH": tag.rsplit("w", 1)[1]})
                         if tag == "rf_chunk_w128":
                             # the width loop's w128 run IS the dc=25
@@ -143,7 +147,7 @@ def pick_tuned_env(since_pos):
                             consider("dispatch", per_tree,
                                      {"BENCH_DISPATCH_TREES": "25"})
                     else:
-                        consider("dispatch", per_tree,
+                        consider("dispatch", per_tree, env_frag or
                                  {"BENCH_DISPATCH_TREES": tag.rsplit("d", 1)[1]})
                 elif tag.startswith("shap_"):
                     try:
@@ -152,13 +156,14 @@ def pick_tuned_env(since_pos):
                     except (IndexError, ValueError):
                         continue
                     if tag == "shap_xla":
-                        consider("shap", steady, {"BENCH_SHAP_IMPL": "xla"})
+                        consider("shap", steady, env_frag or
+                                 {"BENCH_SHAP_IMPL": "xla"})
                     else:  # shap_s{SBLK}_l{LBLK}
                         try:
                             s, l = tag[len("shap_s"):].split("_l")
                         except ValueError:
                             continue
-                        consider("shap", steady,
+                        consider("shap", steady, env_frag or
                                  {"F16_SHAP_SBLK": s, "F16_SHAP_LBLK": l})
     except OSError:
         return {}
