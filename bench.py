@@ -408,6 +408,12 @@ def main():
             "native/treeshap_cext.cc)" if shap_which == "cext"
             else "numpy path-dependent oracle (NO toolchain — speedup "
                  "overstates a _cext-relative win)"),
+        baseline_note=(
+            "SHAP baseline is compiled C as of round 3 (~15x faster than "
+            "the round-2 numpy oracle at bench shapes) — speedups are NOT "
+            "comparable to BENCH_r01/r02 values" if shap_which == "cext"
+            else "numpy-oracle SHAP baseline (toolchain fallback): "
+                 "comparable to BENCH_r01/r02, overstates a C-relative win"),
         t_cpu_scores_s=round(sum(t_base_scores), 2),
         t_cpu_shap_s=round(sum(t_base_shap), 2),
         t_ours_scores_s=result["t_scores"], t_ours_shap_s=result["t_shap"],
