@@ -491,12 +491,12 @@ HIST_BINS = int(os.environ.get("F16_HIST_BINS", "64"))
 # Node-batch width of the hist grower's BFS step, per backend: the MXU
 # wants wide one-hot matmuls (128 untuned pending hardware time); CPU pays
 # per-step cost proportional to the batch width (segment space + padded
-# slots) — measured there: 16 -> 0.19 s, 64 -> 0.54 s, 128 -> 1.2 s for a
-# 25-tree fit at N=800 (mostly-empty windows at the top of every tree).
+# slots) — measured at the bench-fallback shape (25-tree x 10-fold chunk,
+# N=400, SMOTE cap): 4 -> 1.76 s, 8 -> 1.68 s, 16 -> 2.72 s, 32 -> 4.98 s.
 # Results-neutral: per-node RNG keys derive from global node ids (see
 # step() in _fit_one_tree_hist), so any width grows the same forest.
 HIST_NODE_BATCH = int(os.environ.get("F16_HIST_NODE_BATCH", "128"))
-HIST_NODE_BATCH_CPU = int(os.environ.get("F16_HIST_NODE_BATCH_CPU", "16"))
+HIST_NODE_BATCH_CPU = int(os.environ.get("F16_HIST_NODE_BATCH_CPU", "8"))
 
 
 def quantile_edges(x, n_bins=HIST_BINS):
