@@ -491,12 +491,22 @@ HIST_BINS = int(os.environ.get("F16_HIST_BINS", "64"))
 # Node-batch width of the hist grower's BFS step, per backend: the MXU
 # wants wide one-hot matmuls (128 untuned pending hardware time); CPU pays
 # per-step cost proportional to the batch width (segment space + padded
-# slots) — measured at the bench-fallback shape (25-tree x 10-fold chunk,
-# N=400, SMOTE cap): 4 -> 1.76 s, 8 -> 1.68 s, 16 -> 2.72 s, 32 -> 4.98 s.
-# Results-neutral: per-node RNG keys derive from global node ids (see
-# step() in _fit_one_tree_hist), so any width grows the same forest.
+# slots) but per-TREE cost proportional to the step count, so the CPU
+# sweet spot is shape-dependent — measured: 25-tree x 10-fold chunk at
+# N=400/max_nodes=1600: 4 -> 1.76 s, 8 -> 1.68 s, 16 -> 2.72 s,
+# 32 -> 4.98 s; the production dryrun shape (N=1000, max_nodes=4000) is
+# ~25% faster at 16 than 8. Widths are results-neutral (per-node RNG keys
+# derive from global node ids; any width grows the same forest), so the
+# CPU width auto-selects by max_nodes; a nonzero F16_HIST_NODE_BATCH_CPU
+# pins it.
 HIST_NODE_BATCH = int(os.environ.get("F16_HIST_NODE_BATCH", "128"))
-HIST_NODE_BATCH_CPU = int(os.environ.get("F16_HIST_NODE_BATCH_CPU", "8"))
+HIST_NODE_BATCH_CPU = int(os.environ.get("F16_HIST_NODE_BATCH_CPU", "0"))
+
+
+def _cpu_node_batch(max_nodes):
+    if HIST_NODE_BATCH_CPU:
+        return HIST_NODE_BATCH_CPU
+    return 8 if max_nodes <= 1600 else 16
 
 
 def quantile_edges(x, n_bins=HIST_BINS):
@@ -535,7 +545,8 @@ def _fit_one_tree_hist(ohfb, bin_idx, edges, y01, w, key, *, random_splits,
     if hist_impl is None:
         hist_impl = "segsum" if jax.default_backend() == "cpu" else "einsum"
     use_segsum = hist_impl == "segsum"
-    node_batch = (HIST_NODE_BATCH_CPU if jax.default_backend() == "cpu"
+    node_batch = (_cpu_node_batch(max_nodes)
+                  if jax.default_backend() == "cpu"
                   else HIST_NODE_BATCH)  # by real backend, NOT hist_impl —
     # the bitwise segsum/einsum test needs both impls on one node numbering
     bw = min(node_batch, max_nodes)            # node-batch width
