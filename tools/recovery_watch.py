@@ -181,6 +181,17 @@ def chain():
     ok, _ = run_stage("matmul", [py, probe, "matmul"], 180)
     if not ok:
         return False
+    # A listener with a CPU-only jax fallback is NOT a recovery: the chain
+    # would grind hours of CPU-platform runs recorded as device evidence.
+    # The probe stamps the backend it actually initialized on each record.
+    try:
+        with open(os.path.join(REPO, "_scratch", "hw_probe.jsonl")) as fd:
+            last = json.loads(fd.read().strip().splitlines()[-1])
+        if last.get("platform", "") == "cpu":
+            log("matmul ran on the CPU backend — not a device recovery")
+            return False
+    except (OSError, ValueError, IndexError):
+        pass
     ok, _ = run_stage("probe_all", [py, probe, "dt", "rf_chunk", "rf_full",
                                     "et_full", "shap", "shap_equiv",
                                     "predict_ab"], 3600)
