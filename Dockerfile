@@ -12,10 +12,14 @@
 # /home/user/data.
 #
 # Per-subject dependency pins (subjects/<proj>/requirements.txt — a pip
-# freeze of the resolved env at the subject's pinned SHA) belong to a study
-# run; drop them into subjects/ before building to replicate the study
-# exactly, or let setup fall back to unpinned resolution (see
-# runner/containers.provision_subject).
+# freeze of the resolved env at the subject's pinned SHA): the repo vendors
+# the study's 26 freezes, and the COPY below places them straight into the
+# image's SUBJECTS_DIR, so setup runs pinned by default; replace a
+# subject's file before building (or in the work dir at run time — it
+# wins) to re-freeze, and setup falls back to unpinned resolution only
+# when a subject has no pins at all (runner/containers.provision_subject;
+# caveat: the vendored freezes resolved on the study's py3.8 image — see
+# subjects/README.md).
 #
 # Base: noble (Python 3.12). The testinspect plugin traces coverage via
 # sys.monitoring (PEP 669, 3.12+) instead of bundling coverage.py into every
