@@ -146,6 +146,30 @@ def test_cleaning_keeps_are_subset_and_preserve_minority(seed, use_enn):
     np.testing.assert_array_equal(w2[y], w[y])
 
 
+@given(st.integers(0, 10 ** 6), st.integers(0, 10 ** 6), st.booleans(),
+       st.booleans())
+@settings(max_examples=8, deadline=None)
+def test_treeshap_local_accuracy_on_random_forests(seed, key, random_splits,
+                                                   ties):
+    # The Tree SHAP efficiency axiom, on OUR grower's forests with random
+    # inputs: per-sample attributions must sum to p0(x) - E[p0] exactly.
+    # (The fixed-data suites pin this against oracles; this pins it across
+    # randomized structures — duplicate split features, shallow leaves.)
+    from flake16_framework_tpu.ops.treeshap import (
+        expected_p0, forest_shap_class0,
+    )
+
+    x, y, w = _data(seed, ties=ties)
+    f = fit_forest_hist(x, y, w, jax.random.PRNGKey(key), n_trees=4,
+                        bootstrap=True, random_splits=random_splits,
+                        sqrt_features=True, max_depth=7, max_nodes=128)
+    xq = x[:40]
+    phi = np.asarray(forest_shap_class0(f, xq, impl="xla"))
+    p0 = np.asarray(predict_proba(f, xq))[:, 0]
+    base = float(np.asarray(expected_p0(f)))
+    np.testing.assert_allclose(phi.sum(1), p0 - base, atol=2e-5)
+
+
 @given(st.integers(0, 10 ** 6), st.integers(1, 5))
 @settings(**SETTINGS)
 def test_fold_masks_partition_and_stratify(seed, k_pos):
