@@ -6,6 +6,7 @@ nod-comp.tex, od-comp.tex, shap.tex — same file names, same comparison-config
 choices (the paper's hard-coded baselines, experiment.py:672-684)."""
 
 import json
+import os
 import pickle
 
 from flake16_framework_tpu.constants import (
@@ -27,6 +28,8 @@ OD_COMPARISON = (
 def write_figures(tests_file=TESTS_FILE, scores_file=SCORES_FILE,
                   shap_file=SHAP_FILE, subjects=None, star_fetch=None,
                   out_dir="."):
+    os.makedirs(out_dir, exist_ok=True)
+
     def out(name):
         return f"{out_dir}/{name}"
 

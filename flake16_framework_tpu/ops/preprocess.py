@@ -14,8 +14,23 @@ Sign convention follows the reference pin (sklearn 1.0.2 ``PCA._fit_full``:
 ``svd_flip`` with u_based_decision=True — per-component sign from the largest-
 magnitude entry of U). Sign choice is irrelevant to downstream tree F1 (splits
 mirror), but we keep the pinned convention for artifact comparability.
+
+Backend split (trace-time): the component basis comes from
+``jnp.linalg.svd(xc)`` on CPU (LAPACK, microseconds at [N,16]) but from
+``jnp.linalg.eigh`` of the F×F Gram matrix on TPU. XLA:TPU lowers SVD of a
+tall [N,F] matrix to a long iterative program whose single dispatch can
+exceed the tunnel's device-fault envelope (~170 s — PROFILE.md; the round-3
+``et_full`` probe step, the only PCA config probed, was the one step that
+wedged the device). The Gram eigh is an [F,F]=16×16 problem — trivially
+inside the envelope — and spans the same row space with identical ordering
+(descending eigenvalue = descending singular value squared); the u-based
+sign rule below resolves both factorizations' sign ambiguity the same way.
+``F16_PCA_IMPL`` (svd|eigh) overrides for the on-device A/B.
 """
 
+import os
+
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -31,10 +46,32 @@ def _scaler_params(x):
     return mu, sd
 
 
-def fit_preprocess(x, prep_code):
+def _pca_basis(xc, pca_impl):
+    """Component basis vt [F,F] (rows = components, descending variance) of the
+    centered matrix ``xc``. Sign of each row is arbitrary here — the caller's
+    u-based rule fixes it — so svd and eigh are interchangeable bases.
+
+    The env/backend default resolves at TRACE time: a jitted caller caches the
+    executable, so flipping ``F16_PCA_IMPL`` mid-process does NOT re-trace.
+    In-process A/Bs must pass ``pca_impl`` explicitly per jit object (the
+    hw_probe steps run one subprocess per arm for exactly this reason)."""
+    impl = pca_impl or os.environ.get("F16_PCA_IMPL", "") or (
+        "svd" if jax.default_backend() == "cpu" else "eigh")
+    if impl not in ("svd", "eigh"):  # a typo'd A/B arm must not silently
+        raise ValueError(f"pca_impl/F16_PCA_IMPL must be svd|eigh, got {impl!r}")
+    if impl == "svd":
+        _, _, vt = jnp.linalg.svd(xc, full_matrices=False)
+        return vt
+    _, evecs = jnp.linalg.eigh(xc.T @ xc)
+    return evecs[:, ::-1].T
+
+
+def fit_preprocess(x, prep_code, pca_impl=None):
     """Return (mu [F], W [F,F]) such that transform(x) == (x - mu) @ W for the
     preprocessing selected by ``prep_code`` (PREP_NONE/PREP_SCALING/PREP_PCA).
     Jit-safe: ``prep_code`` is a traced int32 dispatched with lax.switch.
+    ``pca_impl`` (svd|eigh) pins the PCA factorization at trace time; default
+    is by backend (see module docstring), ``F16_PCA_IMPL`` overrides.
     """
     n, f = x.shape
     dt = x.dtype
@@ -51,7 +88,7 @@ def fit_preprocess(x, prep_code):
         xs = (x - mu) / sd
         mu2 = xs.mean(axis=0)  # ~0, kept for exactness (PCA re-centers)
         xc = xs - mu2
-        _, _, vt = jnp.linalg.svd(xc, full_matrices=False)
+        vt = _pca_basis(xc, pca_impl)
         # svd_flip(u_based): sign from U's max-|.| row; U column = Xc @ v / s,
         # so sign(U[i,j]) == sign((Xc @ vt[j])[i]) and we avoid materializing U.
         proj = xc @ vt.T  # [N, F] = U * S

@@ -1,8 +1,11 @@
 """Preprocessing parity vs sklearn (reference grid axis experiment.py:82-86)."""
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from sklearn.decomposition import PCA
 from sklearn.pipeline import Pipeline
 from sklearn.preprocessing import StandardScaler
@@ -18,8 +21,9 @@ def _x(n=300, f=16, seed=0):
     return x
 
 
-def _ours(x, code):
-    mu, w = jax.jit(fit_preprocess)(jnp.asarray(x), jnp.int32(code))
+def _ours(x, code, pca_impl=None):
+    fn = jax.jit(functools.partial(fit_preprocess, pca_impl=pca_impl))
+    mu, w = fn(jnp.asarray(x), jnp.int32(code))
     return np.asarray(transform(jnp.asarray(x), mu, w))
 
 
@@ -36,12 +40,15 @@ def test_scaling_matches_sklearn():
     )
 
 
-def test_pca_matches_sklearn_up_to_sign():
+@pytest.mark.parametrize("impl", ["svd", "eigh"])
+def test_pca_matches_sklearn_up_to_sign(impl):
+    """Both factorizations (CPU-default svd, TPU-default Gram eigh) against
+    the sklearn pipeline."""
     x = _x(seed=1)
     ref = Pipeline(
         [("s", StandardScaler()), ("p", PCA(random_state=0))]
     ).fit_transform(x)
-    ours = _ours(x, PREP_PCA)
+    ours = _ours(x, PREP_PCA, pca_impl=impl)
 
     assert ours.shape == ref.shape
     # Installed sklearn (1.9) may use a different svd_flip convention than the
@@ -59,3 +66,24 @@ def test_pca_orthogonal_components():
     cov = np.cov(ours.T)
     off = cov - np.diag(np.diag(cov))
     assert np.abs(off).max() < 1e-6
+
+
+def test_pca_eigh_matches_svd():
+    """The TPU-default Gram-eigh basis and the CPU-default LAPACK svd basis
+    produce the same transform once the u-based sign rule is applied. eigh
+    exists because XLA:TPU lowers svd of [N,F] to an iterative program whose
+    single dispatch can blow the tunnel's device-fault envelope (PROFILE.md
+    round-3: the PCA probe config was the step that wedged the device)."""
+    for seed, n, f in [(1, 300, 16), (3, 1500, 16), (4, 500, 8)]:
+        x = _x(n=n, f=f, seed=seed)
+        outs = {impl: _ours(x, PREP_PCA, pca_impl=impl)
+                for impl in ("svd", "eigh")}
+        np.testing.assert_allclose(outs["svd"], outs["eigh"],
+                                   rtol=0, atol=1e-6)
+
+
+def test_pca_impl_typo_raises():
+    """A typo'd A/B arm (e.g. F16_PCA_IMPL=SVD) must fail loudly, not
+    silently measure eigh-vs-eigh."""
+    with pytest.raises(ValueError, match="svd|eigh"):
+        _ours(_x(), PREP_PCA, pca_impl="SVD")

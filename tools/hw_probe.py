@@ -58,7 +58,69 @@ keys = ('NOD', 'Flake16', 'Scaling', 'SMOTE', 'Random Forest')
 t0 = time.time(); eng.run_config(keys); print('compile_s', round(time.time() - t0, 2))
 t0 = time.time(); r = eng.run_config(keys); print('steady_s', round(time.time() - t0, 2))
 """,
-    # ET full config.
+    # PCA prep ALONE (device default = Gram eigh) — attributes any wedge
+    # to the preprocessing stage by name, and checks the device transform
+    # against a host-side numpy-LAPACK svd of the same matrix. Round-3
+    # finding: the one PCA probe config was the step that wedged the
+    # device; XLA:TPU lowers svd of [N,F] to a long iterative program, so
+    # the TPU default is now eigh of the F×F Gram matrix
+    # (ops/preprocess.py).
+    "prep_pca": """
+import time
+import jax, jax.numpy as jnp
+import numpy as np
+import bench
+from probe_common import N_TESTS
+from flake16_framework_tpu.config import PREP_PCA
+from flake16_framework_tpu.ops.preprocess import fit_preprocess, transform
+feats, *_ = bench.make_data(N_TESTS)
+x = jnp.asarray(feats[:, :16])
+fn = jax.jit(fit_preprocess)
+t0 = time.time(); mu, w = jax.block_until_ready(fn(x, jnp.int32(PREP_PCA)))
+print('pca_compile_s', round(time.time() - t0, 2))
+t0 = time.time(); mu, w = jax.block_until_ready(fn(x, jnp.int32(PREP_PCA)))
+print('pca_steady_s', round(time.time() - t0, 3))
+ours = np.asarray(transform(x, mu, w))
+xh = np.asarray(x, np.float64)
+mu_h = xh.mean(0); sd = xh.std(0); sd[sd == 0] = 1.0
+xc = (xh - mu_h) / sd; xc -= xc.mean(0)
+_, _, vt = np.linalg.svd(xc, full_matrices=False)
+proj = xc @ vt.T
+sg = np.sign(proj[np.abs(proj).argmax(0), np.arange(vt.shape[0])])
+sg[sg == 0] = 1.0
+ref = proj * sg
+print('pca_vs_host_lapack_maxabs %.3e' % np.abs(ours - ref).max())
+""",
+    # svd-on-device arm of the PCA A/B — the suspected round-3 wedger.
+    # NOT in the default step order: run it explicitly, last, in a
+    # session that can afford to lose the tunnel.
+    "prep_pca_svd": """
+import functools, time
+import jax, jax.numpy as jnp
+import bench
+from probe_common import N_TESTS
+from flake16_framework_tpu.config import PREP_PCA
+from flake16_framework_tpu.ops.preprocess import fit_preprocess
+feats, *_ = bench.make_data(N_TESTS)
+x = jnp.asarray(feats[:, :16])
+fn = jax.jit(functools.partial(fit_preprocess, pca_impl='svd'))
+t0 = time.time(); mu, w = jax.block_until_ready(fn(x, jnp.int32(PREP_PCA)))
+print('pca_svd_compile_s', round(time.time() - t0, 2))
+t0 = time.time(); mu, w = jax.block_until_ready(fn(x, jnp.int32(PREP_PCA)))
+print('pca_svd_steady_s', round(time.time() - t0, 3))
+""",
+    # ET WITHOUT PCA (the bench's ENN config) — separates ET-grower cost
+    # from PCA cost on device.
+    "et_enn": """
+from probe_common import make_engine
+eng = make_engine()
+import time
+keys = ('NOD', 'Flake16', 'Scaling', 'ENN', 'Extra Trees')
+t0 = time.time(); eng.run_config(keys); print('compile_s', round(time.time() - t0, 2))
+t0 = time.time(); r = eng.run_config(keys); print('steady_s', round(time.time() - t0, 2))
+""",
+    # ET full config (PCA + SMOTE Tomek). Wedged the device in round 3
+    # under the svd PCA path; runs after every other step by default.
     "et_full": """
 from probe_common import make_engine
 eng = make_engine()
@@ -194,8 +256,13 @@ def tune_shap():
 
 
 def main():
-    steps = sys.argv[1:] or ["matmul", "dt", "rf_chunk", "rf_full",
-                             "et_full", "shap", "shap_equiv", "predict_ab"]
+    # et_full (PCA + SMOTE Tomek) wedged the device in round 3, so it runs
+    # LAST by default: a wedge there still leaves every other measurement
+    # on the record. prep_pca runs early — cheap, and it attributes a
+    # PCA-stage wedge by name. prep_pca_svd is deliberately absent (opt-in).
+    steps = sys.argv[1:] or ["matmul", "prep_pca", "dt", "rf_chunk",
+                             "rf_full", "et_enn", "shap", "shap_equiv",
+                             "predict_ab", "et_full"]
     tuners = {"tune_hist": tune_hist, "tune_shap": tune_shap}
     unknown = [s for s in steps if s not in STEP_SRC and s not in tuners]
     if unknown:

@@ -192,9 +192,10 @@ def chain():
             return False
     except (OSError, ValueError, IndexError):
         pass
-    ok, _ = run_stage("probe_all", [py, probe, "dt", "rf_chunk", "rf_full",
-                                    "et_full", "shap", "shap_equiv",
-                                    "predict_ab"], 3600)
+    ok, _ = run_stage("probe_all", [py, probe, "prep_pca", "dt", "rf_chunk",
+                                    "rf_full", "et_enn", "shap",
+                                    "shap_equiv", "predict_ab", "et_full"],
+                      3600)
     # bench even if one probe stage failed: stages are independent and the
     # bench has its own probe + fallback protocol.
     def persist_bench_json(out, filename):
