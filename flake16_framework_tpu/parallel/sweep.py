@@ -255,7 +255,7 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
 
 
 def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
-                 dc, *, tree_axis, fold_chunk=None):
+                 dc, *, tree_axis, fold_chunk=None, timings=None):
     """The dispatch-chunked fit protocol, shared by the single-device and
     mesh-batched paths: one prep+resample dispatch, then bounded-duration
     tree-growth dispatches (each blocked — PROFILE.md fault envelope),
@@ -297,8 +297,22 @@ def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
             jax.block_until_ready(out)
             return out
 
+    # timings (when given) gets per-stage walls with a block after each
+    # stage — the TPU attribution instrument (PROFILE.md round 3: rf_full
+    # steady was 13.18 s while its growth chunks measured ~0 s; the split
+    # below names where per-config time actually goes). The extra syncs
+    # exist only in timed mode; the default path keeps its dispatch overlap.
+    t0 = time.time()
     xs, ys, ws, edges, xp, y = prep_fn(*fit_args)
+    if timings is not None:
+        jax.block_until_ready(xs)
+        timings["prep_s"] = round(time.time() - t0, 4)
+    t0 = time.time()
     tks = tree_keys_thunk()
+    if timings is not None:
+        jax.block_until_ready(tks)
+        timings["tree_keys_s"] = round(time.time() - t0, 4)
+        timings["chunks_s"] = []
     n_folds = xs.shape[0]
     step = dc if dc is not None else n_trees
     if fold_chunk is not None and fold_chunk < n_folds:
@@ -311,6 +325,7 @@ def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
     for flo, fhi in fold_ranges:
         parts = []
         for lo in range(0, n_trees, step):
+            t0 = time.time()
             if tree_axis == 1:  # single-device: tensors [folds, ...]
                 forest_c = run_bounded(lambda: fit_chunk_fn(
                     xs[flo:fhi], ys[flo:fhi], ws[flo:fhi], edges,
@@ -320,6 +335,8 @@ def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
                 forest_c = run_bounded(lambda: fit_chunk_fn(
                     xs, ys, ws, edges, tks[:, :, lo:lo + step],
                 ))
+            if timings is not None:  # run_bounded already blocked
+                timings["chunks_s"].append(round(time.time() - t0, 4))
             parts.append(forest_c)
         fold_parts.append(parts[0] if len(parts) == 1
                           else trees.concat_trees(parts, axis=tree_axis))
@@ -334,7 +351,10 @@ def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
             max_depth=jnp.concatenate(
                 [p.max_depth for p in fold_parts])
         )
+    t0 = time.time()
     jax.block_until_ready(forest)
+    if timings is not None:
+        timings["concat_s"] = round(time.time() - t0, 4)
     return forest, xp, y
 
 
@@ -428,9 +448,11 @@ class SweepEngine:
             )
         return self._fns[key]
 
-    def run_config(self, config_keys):
+    def run_config(self, config_keys, timings=None):
         """Run one config; returns (t_train, t_test, scores, scores_total)
-        in the reference scores.pkl value schema (README.rst:78-134)."""
+        in the reference scores.pkl value schema (README.rst:78-134).
+        ``timings``: optional dict filled with per-stage walls (extra device
+        syncs in timed mode only — see _chunked_fit)."""
         fl_name, fs_name, prep_name, bal_name, model_name = config_keys
         (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys), cols = \
             self._get_fns(fs_name, model_name)
@@ -460,19 +482,28 @@ class SweepEngine:
         if dc is not None or df is not None:
             forest, xp, y = _chunked_fit(
                 cv_prep, cv_fit_chunk, lambda: cv_tree_keys(key), fit_args,
-                n_trees, dc, tree_axis=1, fold_chunk=df,
+                n_trees, dc, tree_axis=1, fold_chunk=df, timings=timings,
             )
         else:
             forest, xp, y = cv_fit(*fit_args)
             jax.block_until_ready(forest)
         t_train = time.time() - t0
+        if timings is not None:
+            timings["fit_total_s"] = round(t_train, 4)
 
         t0 = time.time()
         counts = cv_score(
             forest, xp, y, jnp.asarray(test_mask),
             jnp.asarray(self.project_ids),
         )
-        counts = np.asarray(counts)
+        if timings is not None:
+            jax.block_until_ready(counts)
+            timings["score_s"] = round(time.time() - t0, 4)
+            t1 = time.time()
+            counts = np.asarray(counts)
+            timings["counts_to_host_s"] = round(time.time() - t1, 4)
+        else:
+            counts = np.asarray(counts)
         t_test = time.time() - t0
 
         scores, scores_total = format_scores(

@@ -285,3 +285,28 @@ def test_chunked_fit_retries_transient_unavailable(monkeypatch):
         sweep._chunked_fit(prep_fn, misleading_chunk, keys_thunk, (), t, 2,
                            tree_axis=1)
     assert calls["n"] == 1  # no second attempt
+
+
+def test_run_config_timed_mode_is_results_neutral(engine):
+    """timings= fills the per-stage attribution dict (the TPU probe's
+    instrument for the round-3 "13 s outside the growth chunks" unknown)
+    without changing any result: scores from the timed pass must equal the
+    untimed pass bit-for-bit, and the stage walls must cover the fit."""
+    keys = ("NOD", "Flake16", "Scaling", "SMOTE", "Random Forest")
+    plain = engine.run_config(keys)
+    tm = {}
+    timed = engine.run_config(keys, timings=tm)
+    assert timed[2] == plain[2] and timed[3] == plain[3]
+    assert {"fit_total_s", "score_s", "counts_to_host_s"} <= set(tm)
+    # engine has no dispatch_trees override -> single-dispatch fit, no
+    # chunk breakdown; with chunking the dict also carries prep/chunks.
+    eng_chunked = sweep.SweepEngine(
+        engine.features, engine.labels_raw, engine.projects,
+        engine.project_names, engine.project_ids, max_depth=24,
+        tree_overrides={"Random Forest": 8}, dispatch_trees=4,
+    )
+    tm2 = {}
+    chunked = eng_chunked.run_config(keys, timings=tm2)
+    assert chunked[2] == plain[2] and chunked[3] == plain[3]
+    assert {"prep_s", "tree_keys_s", "chunks_s", "concat_s"} <= set(tm2)
+    assert len(tm2["chunks_s"]) == 2  # 8 trees / 4 per dispatch

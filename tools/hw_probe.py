@@ -49,14 +49,18 @@ for line in chunk_fit_times(('NOD', 'Flake16', 'Scaling', 'SMOTE',
                              'Random Forest')):
     print(line)
 """,
-    # Full RF config through run_config (all chunks + score).
+    # Full RF config through run_config (all chunks + score), with the
+    # per-stage attribution dict on the steady pass (round-3 unknown:
+    # 13.18 s steady vs ~0 s growth chunks).
     "rf_full": """
 from probe_common import make_engine
 eng = make_engine()
 import time
 keys = ('NOD', 'Flake16', 'Scaling', 'SMOTE', 'Random Forest')
 t0 = time.time(); eng.run_config(keys); print('compile_s', round(time.time() - t0, 2))
-t0 = time.time(); r = eng.run_config(keys); print('steady_s', round(time.time() - t0, 2))
+tm = {}
+t0 = time.time(); r = eng.run_config(keys, timings=tm); print('steady_s', round(time.time() - t0, 2))
+print('stages', tm)
 """,
     # PCA prep ALONE (device default = Gram eigh) — attributes any wedge
     # to the preprocessing stage by name, and checks the device transform
@@ -117,7 +121,9 @@ eng = make_engine()
 import time
 keys = ('NOD', 'Flake16', 'Scaling', 'ENN', 'Extra Trees')
 t0 = time.time(); eng.run_config(keys); print('compile_s', round(time.time() - t0, 2))
-t0 = time.time(); r = eng.run_config(keys); print('steady_s', round(time.time() - t0, 2))
+tm = {}
+t0 = time.time(); r = eng.run_config(keys, timings=tm); print('steady_s', round(time.time() - t0, 2))
+print('stages', tm)
 """,
     # ET full config (PCA + SMOTE Tomek). Wedged the device in round 3
     # under the svd PCA path; runs after every other step by default.
@@ -127,7 +133,9 @@ eng = make_engine()
 import time
 keys = ('OD', 'Flake16', 'PCA', 'SMOTE Tomek', 'Extra Trees')
 t0 = time.time(); eng.run_config(keys); print('compile_s', round(time.time() - t0, 2))
-t0 = time.time(); r = eng.run_config(keys); print('steady_s', round(time.time() - t0, 2))
+tm = {}
+t0 = time.time(); r = eng.run_config(keys, timings=tm); print('steady_s', round(time.time() - t0, 2))
+print('stages', tm)
 """,
     # Pallas Tree SHAP: one 25-tree slice, then the full chunked explain.
     "shap": """
