@@ -14,15 +14,21 @@ from flake16_framework_tpu.parallel import sweep
 from flake16_framework_tpu.utils.synth import make_dataset
 
 
-@pytest.fixture(scope="module")
-def engine():
+def _make_engine(**overrides):
+    """One constructor for every engine this module compares — engines built
+    from different arg copies could silently drift configuration."""
     feats, labels, pids = make_dataset(n_tests=240, n_projects=6, seed=11)
     names = [f"project{p:02d}" for p in range(6)]
     projects = np.array([names[p] for p in pids])
-    return sweep.SweepEngine(
-        feats, labels, projects, names, pids,
-        max_depth=24, tree_overrides={"Extra Trees": 8, "Random Forest": 8},
-    )
+    kw = dict(max_depth=24,
+              tree_overrides={"Extra Trees": 8, "Random Forest": 8})
+    kw.update(overrides)
+    return sweep.SweepEngine(feats, labels, projects, names, pids, **kw)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return _make_engine()
 
 
 def test_dt_config_total_confusion_matches_sklearn(engine):
@@ -300,13 +306,30 @@ def test_run_config_timed_mode_is_results_neutral(engine):
     assert {"fit_total_s", "score_s", "counts_to_host_s"} <= set(tm)
     # engine has no dispatch_trees override -> single-dispatch fit, no
     # chunk breakdown; with chunking the dict also carries prep/chunks.
-    eng_chunked = sweep.SweepEngine(
-        engine.features, engine.labels_raw, engine.projects,
-        engine.project_names, engine.project_ids, max_depth=24,
-        tree_overrides={"Random Forest": 8}, dispatch_trees=4,
-    )
+    eng_chunked = _make_engine(dispatch_trees=4)
     tm2 = {}
     chunked = eng_chunked.run_config(keys, timings=tm2)
     assert chunked[2] == plain[2] and chunked[3] == plain[3]
     assert {"prep_s", "tree_keys_s", "chunks_s", "concat_s"} <= set(tm2)
     assert len(tm2["chunks_s"]) == 2  # 8 trees / 4 per dispatch
+
+
+def test_pca_config_eigh_impl_inside_cv_program(engine, monkeypatch):
+    """The TPU-default Gram-eigh PCA basis exercised INSIDE the full jitted
+    CV program (the path parity.py runs on device), not just standalone
+    fit_preprocess: same config under F16_PCA_IMPL=eigh must reproduce the
+    svd path's confusion counts up to PCA's float rotation noise — the
+    per-project int counts are allowed to differ only by tie-break samples.
+    A fresh engine forces a fresh family trace (env is read at trace time)."""
+    keys = ("NOD", "Flake16", "PCA", "Tomek Links", "Random Forest")
+    plain = engine.run_config(keys)
+
+    monkeypatch.setenv("F16_PCA_IMPL", "eigh")
+    eigh_res = _make_engine().run_config(keys)
+
+    tot_svd = np.array(plain[3][:3], float)
+    tot_eigh = np.array(eigh_res[3][:3], float)
+    # fp/fn/tp may move by a handful of samples where a split threshold
+    # lands inside the ~1e-6 basis difference; wholesale disagreement
+    # means the eigh basis broke inside the traced program.
+    assert np.abs(tot_svd - tot_eigh).sum() <= 6, (tot_svd, tot_eigh)

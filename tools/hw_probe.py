@@ -38,8 +38,10 @@ eng = make_engine()
 import time
 keys = ('NOD', 'Flake16', 'None', 'None', 'Decision Tree')
 t0 = time.time(); eng.run_config(keys); print('compile_s', round(time.time() - t0, 2))
-t0 = time.time(); r = eng.run_config(keys); print('steady_s', round(time.time() - t0, 2))
+tm = {}
+t0 = time.time(); r = eng.run_config(keys, timings=tm); print('steady_s', round(time.time() - t0, 2))
 print('t_train_fold_s', round(r[0], 3))
+print('stages', tm)
 """,
     # Histogram-grower RF: ONE chunked tree-growth dispatch (25 trees x 10
     # folds) after prep, timed separately from its compile.
