@@ -314,7 +314,7 @@ def test_run_config_timed_mode_is_results_neutral(engine):
     assert len(tm2["chunks_s"]) == 2  # 8 trees / 4 per dispatch
 
 
-def test_pca_config_eigh_impl_inside_cv_program(engine, monkeypatch):
+def test_pca_config_eigh_impl_inside_cv_program(monkeypatch):
     """The TPU-default Gram-eigh PCA basis exercised INSIDE the full jitted
     CV program (the path parity.py runs on device), not just standalone
     fit_preprocess: same config under F16_PCA_IMPL=eigh must reproduce the
@@ -322,7 +322,12 @@ def test_pca_config_eigh_impl_inside_cv_program(engine, monkeypatch):
     per-project int counts are allowed to differ only by tie-break samples.
     A fresh engine forces a fresh family trace (env is read at trace time)."""
     keys = ("NOD", "Flake16", "PCA", "Tomek Links", "Random Forest")
-    plain = engine.run_config(keys)
+    # Fresh engine for EACH arm, with the env pinned before its family
+    # traces: an inherited F16_PCA_IMPL (e.g. left over from a probe
+    # session) must not silently turn this into eigh-vs-eigh, and the
+    # module fixture's cached family trace must not leak into either arm.
+    monkeypatch.delenv("F16_PCA_IMPL", raising=False)
+    plain = _make_engine().run_config(keys)
 
     monkeypatch.setenv("F16_PCA_IMPL", "eigh")
     eigh_res = _make_engine().run_config(keys)

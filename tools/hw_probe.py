@@ -164,6 +164,16 @@ for line in predict_ab():
 }
 
 
+# The default step order — ALSO the recovery watcher's probe_all stage
+# (tools/recovery_watch.py imports this list; keep it the single source).
+# et_full (PCA + SMOTE Tomek) wedged the device in round 3, so it runs
+# LAST: a wedge there still leaves every other measurement on the record.
+# prep_pca runs early — cheap, and it attributes a PCA-stage wedge by
+# name. prep_pca_svd is deliberately absent (opt-in).
+DEFAULT_STEPS = ["matmul", "prep_pca", "dt", "rf_chunk", "rf_full",
+                 "et_enn", "shap", "shap_equiv", "predict_ab", "et_full"]
+
+
 # Every step reports the backend jax ACTUALLY initialized — authoritative
 # provenance (JAX_PLATFORMS alone can lie: an unset var with a failed TPU
 # init silently falls back to CPU, which must never read as device
@@ -234,7 +244,10 @@ def tune_hist():
     # staying inside the fault envelope. (dc=25 is the width loop's
     # rf_chunk_w128 — BENCH_DISPATCH_TREES defaults to 25 — so only the
     # ends of the range need their own runs.)
-    for dc in (2, 50):
+    # d100 = the whole 100-tree fit as ONE dispatch: with measured chunk
+    # compute ~0 s (2026-07-31 probe), the fault envelope no longer binds
+    # and the un-chunked fit is the candidate winner.
+    for dc in (2, 50, 100):
         ok = run_step(
             "rf_chunk", 600,
             env_extra={"BENCH_DISPATCH_TREES": str(dc)},
@@ -266,13 +279,7 @@ def tune_shap():
 
 
 def main():
-    # et_full (PCA + SMOTE Tomek) wedged the device in round 3, so it runs
-    # LAST by default: a wedge there still leaves every other measurement
-    # on the record. prep_pca runs early — cheap, and it attributes a
-    # PCA-stage wedge by name. prep_pca_svd is deliberately absent (opt-in).
-    steps = sys.argv[1:] or ["matmul", "prep_pca", "dt", "rf_chunk",
-                             "rf_full", "et_enn", "shap", "shap_equiv",
-                             "predict_ab", "et_full"]
+    steps = sys.argv[1:] or DEFAULT_STEPS
     tuners = {"tune_hist": tune_hist, "tune_shap": tune_shap}
     unknown = [s for s in steps if s not in STEP_SRC and s not in tuners]
     if unknown:

@@ -43,6 +43,13 @@ from flake16_framework_tpu.utils.relay import (  # noqa: E402
     RELAY_PORT as PORT, relay_listener_up,
 )
 
+
+def hw_probe_default_steps():
+    """hw_probe.DEFAULT_STEPS — the single source of the probe order."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import hw_probe  # noqa: PLC0415
+    return list(hw_probe.DEFAULT_STEPS)
+
 LOG = os.path.join(REPO, "_scratch", "watcher_r03.log")
 STATUS = os.path.join(REPO, "_scratch", "watcher_status.json")
 
@@ -192,14 +199,14 @@ def chain():
             return False
     except (OSError, ValueError, IndexError):
         pass
-    # 9 steps x 600 s worst case + slack: the budget must survive cold
+    # hw_probe's own default order, minus the matmul the chain already ran.
+    # Budget: each step x 600 s worst case + slack — it must survive cold
     # compiles on every step AND still reach the deliberately-last et_full
     # (hw_probe stops at the first failure anyway, so the budget only
     # binds when steps run long, not when the tunnel dies).
-    ok, _ = run_stage("probe_all", [py, probe, "prep_pca", "dt", "rf_chunk",
-                                    "rf_full", "et_enn", "shap",
-                                    "shap_equiv", "predict_ab", "et_full"],
-                      7200)
+    probe_steps = [s for s in hw_probe_default_steps() if s != "matmul"]
+    ok, _ = run_stage("probe_all", [py, probe] + probe_steps,
+                      600 * len(probe_steps) + 1800)
     # bench even if one probe stage failed: stages are independent and the
     # bench has its own probe + fallback protocol.
     def persist_bench_json(out, filename):
