@@ -199,16 +199,6 @@ def chain():
             return False
     except (OSError, ValueError, IndexError):
         pass
-    # hw_probe's own default order, minus the matmul the chain already ran.
-    # Budget: each step x 600 s worst case + slack — it must survive cold
-    # compiles on every step AND still reach the deliberately-last et_full
-    # (hw_probe stops at the first failure anyway, so the budget only
-    # binds when steps run long, not when the tunnel dies).
-    probe_steps = [s for s in hw_probe_default_steps() if s != "matmul"]
-    ok, _ = run_stage("probe_all", [py, probe] + probe_steps,
-                      600 * len(probe_steps) + 1800)
-    # bench even if one probe stage failed: stages are independent and the
-    # bench has its own probe + fallback protocol.
     def persist_bench_json(out, filename):
         # only persist a parseable result line — a failed bench's stdout
         # tail must not clobber a previous good record
@@ -222,6 +212,12 @@ def chain():
         with open(os.path.join(REPO, "_scratch", filename), "w") as fd:
             fd.write(lines[-1] + "\n")
 
+    # HEADLINE FIRST (learned 2026-07-31: a ~16 min up-window went entirely
+    # to probes and the bench never touched the device before the next
+    # wedge). The two north-star numbers — BENCH backend=tpu and
+    # PARITY.json — run before any probe/tune stage; the compile cache from
+    # prior sessions makes the bench's warmups cheap, and bench has its own
+    # probe + CPU-fallback protocol if the device died since matmul.
     ok_b, out = run_stage("bench", [py, os.path.join(REPO, "bench.py")], 2700)
     persist_bench_json(out, "bench_tpu.json")
     if not ok_b and not listener_up():
@@ -231,6 +227,18 @@ def chain():
         env_extra={"PARITY_SKLEARN_CACHE": os.path.join(
             REPO, "parity_sklearn_n4000_t100.json")},
     )
+    if not ok_p and not listener_up():
+        return False
+    # Attribution probes after the headline numbers are on disk. hw_probe's
+    # own default order, minus the matmul the chain already ran; budget =
+    # each step x 600 s worst case + slack, so cold compiles on every step
+    # still reach the deliberately-last et_full (hw_probe stops at the
+    # first failure anyway).
+    probe_steps = [s for s in hw_probe_default_steps() if s != "matmul"]
+    ok, _ = run_stage("probe_all", [py, probe] + probe_steps,
+                      600 * len(probe_steps) + 1800)
+    if not ok and not listener_up():
+        return False
     # 6 tune_hist + 10 tune_shap combos x 600 s worst case each, plus slack
     probe_log = os.path.join(REPO, "_scratch", "hw_probe.jsonl")
     tune_from = os.path.getsize(probe_log) if os.path.exists(probe_log) else 0
