@@ -347,6 +347,32 @@ def run_worker(n_tests, n_trees, env_extra=None):
         return None, (r.stdout or "")[-400:]
 
 
+def _recent_watcher_tpu_line(max_age_s):
+    """Fresh full-size backend=tpu bench line the recovery watcher
+    persisted this round, as (parsed line, filename, age_s) — None when no
+    fresh-enough TPU record exists. Selection is by file order: the tuned
+    re-bench wins over the default-knob run when both are fresh."""
+    for name in ("bench_tpu_tuned.json", "bench_tpu.json"):
+        path = os.path.join(REPO, "_scratch", name)
+        try:
+            age = time.time() - os.path.getmtime(path)
+            if age > max_age_s:
+                continue
+            with open(path) as fd:
+                line = json.loads(fd.read().strip())
+        except (OSError, ValueError):
+            continue
+        det = line.get("detail") or {}
+        # "source" marks a line that was ITSELF a cached re-emission — using
+        # it would launder the original measurement's age through a fresh
+        # file mtime (the watcher also refuses to persist such lines).
+        if (det.get("backend") != "tpu" or "_fb_" in line.get("metric", "")
+                or "source" in det):
+            continue
+        return line, name, age  # tuned is listed first: first hit wins
+    return None
+
+
 def main():
     detail = {}
     result, err = None, None
@@ -374,6 +400,28 @@ def main():
                     detail["tpu_attempt_2"] = err
             else:
                 detail["tpu_reprobe"] = probe_err
+
+    if result is None and os.environ.get("BENCH_DEVICE") != "cpu":
+        # The recovery watcher (tools/recovery_watch.py) may have landed a
+        # full-size TPU bench earlier in this round and then kept the single
+        # device claim busy with its tune/trace stages — in which case THIS
+        # process's probe times out against healthy hardware. Reporting the
+        # watcher's persisted result line (verbatim, with provenance) is a
+        # real same-round hardware measurement; silently downgrading to the
+        # CPU fallback would discard it. Freshness-bounded to this round.
+        cached = _recent_watcher_tpu_line(max_age_s=12 * 3600)
+        if cached is not None:
+            line, src, age_s = cached
+            # what actually failed live: probe, re-probe, or the worker runs
+            live_fail = {k: v for k, v in detail.items()
+                         if k.startswith("tpu_")}
+            line.setdefault("detail", {})
+            line["detail"]["source"] = (
+                f"recovery_watcher bench ({src}, {age_s / 60:.0f} min ago); "
+                "live run failed at report time (see live_failure)")
+            line["detail"]["live_failure"] = live_fail or "unknown"
+            print(json.dumps(line))
+            return
 
     if result is None:
         # Fallback: the SAME pipeline — all three model families and both

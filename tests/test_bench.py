@@ -45,3 +45,82 @@ def test_bench_json_contract_cpu_fallback():
     assert len(d["per_config_s"]) == 6
     assert all(v > 0 for v in d["per_config_s"].values())
     assert d["t_ours_shap_s"] > 0 and d["t_cpu_shap_s"] > 0
+
+
+def test_watcher_cached_tpu_line_preferred_and_bounded(tmp_path, monkeypatch):
+    """When the live probe fails but the recovery watcher persisted a
+    fresh full-size backend=tpu line this round, bench reports THAT line
+    (tuned run preferred) with provenance — and ignores stale, fallback,
+    or cpu-backend records."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    scratch = tmp_path / "_scratch"
+    scratch.mkdir()
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+
+    def put(name, metric, backend):
+        (scratch / name).write_text(json.dumps({
+            "metric": metric, "value": 12.0,
+            "unit": "x_vs_single_host_cpu_stack", "vs_baseline": 12.0,
+            "detail": {"backend": backend},
+        }) + "\n")
+
+    # Nothing on disk -> None.
+    assert bench._recent_watcher_tpu_line(3600) is None
+    # A cpu-backend record (wedged-session fallback) must NOT count.
+    put("bench_tpu.json", "scores_shap_probe_6cfg_n2000_speedup", "cpu")
+    assert bench._recent_watcher_tpu_line(3600) is None
+    # A fallback-tagged record must NOT count even if backend says tpu.
+    put("bench_tpu.json", "scores_shap_probe_fb_6cfg_n400_t25_speedup", "tpu")
+    assert bench._recent_watcher_tpu_line(3600) is None
+    # A real full-size tpu record counts...
+    put("bench_tpu.json", "scores_shap_probe_6cfg_n2000_speedup", "tpu")
+    line, src, age = bench._recent_watcher_tpu_line(3600)
+    assert src == "bench_tpu.json" and line["value"] == 12.0
+    # ...the tuned re-bench wins when present...
+    put("bench_tpu_tuned.json", "scores_shap_probe_6cfg_n2000_speedup", "tpu")
+    line, src, _ = bench._recent_watcher_tpu_line(3600)
+    assert src == "bench_tpu_tuned.json"
+    # ...and staleness is enforced.
+    old = os.path.getmtime(scratch / "bench_tpu.json") - 7200
+    os.utime(scratch / "bench_tpu.json", (old, old))
+    os.utime(scratch / "bench_tpu_tuned.json", (old, old))
+    assert bench._recent_watcher_tpu_line(3600) is None
+
+
+def test_cached_reemission_is_not_reused_or_repersisted(tmp_path, monkeypatch):
+    """A line that was itself a cached replay (detail.source set) must be
+    rejected by both the bench-side selector and the watcher-side persist,
+    so one real measurement cannot launder its age through fresh mtimes."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod2", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    scratch = tmp_path / "_scratch"
+    scratch.mkdir()
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    replay = {"metric": "scores_shap_probe_6cfg_n2000_speedup", "value": 9.0,
+              "unit": "x_vs_single_host_cpu_stack", "vs_baseline": 9.0,
+              "detail": {"backend": "tpu", "source": "recovery_watcher ..."}}
+    (scratch / "bench_tpu.json").write_text(json.dumps(replay) + "\n")
+    assert bench._recent_watcher_tpu_line(3600) is None
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import recovery_watch  # noqa: PLC0415
+    monkeypatch.setattr(recovery_watch, "REPO", str(tmp_path))
+    # The watcher-side persist refuses the replayed line (a DIFFERENT
+    # value from the pre-seeded file, so a wrongful rewrite is detectable)
+    replay2 = dict(replay, value=10.0)
+    recovery_watch.persist_bench_json(json.dumps(replay2), "bench_tpu.json")
+    assert json.loads(
+        (scratch / "bench_tpu.json").read_text())["value"] == 9.0
+    # ...but accepts a real measurement line.
+    real = dict(replay, value=11.0, detail={"backend": "tpu"})
+    recovery_watch.persist_bench_json(json.dumps(real), "bench_tpu.json")
+    assert json.loads((scratch / "bench_tpu.json").read_text())["value"] == 11.0

@@ -180,6 +180,26 @@ def pick_tuned_env(since_pos):
     return env
 
 
+def persist_bench_json(out, filename):
+    """Persist a bench stage's final stdout line to _scratch/<filename> —
+    only a parseable result line (a failed bench's stdout tail must not
+    clobber a previous good record), and never a line whose detail carries
+    "source": that is bench REPLAYING an earlier watcher record (bench.py
+    _recent_watcher_tpu_line), and persisting it would stamp a fresh mtime
+    on an old measurement, defeating the replay path's freshness bound."""
+    lines = out.strip().splitlines() if out else []
+    if not lines:
+        return
+    try:
+        line = json.loads(lines[-1])
+    except ValueError:
+        return
+    if "source" in (line.get("detail") or {}):
+        return
+    with open(os.path.join(REPO, "_scratch", filename), "w") as fd:
+        fd.write(lines[-1] + "\n")
+
+
 def chain():
     """The recovery chain. Returns True when it ran to completion."""
     py = sys.executable
@@ -199,19 +219,6 @@ def chain():
             return False
     except (OSError, ValueError, IndexError):
         pass
-    def persist_bench_json(out, filename):
-        # only persist a parseable result line — a failed bench's stdout
-        # tail must not clobber a previous good record
-        lines = out.strip().splitlines() if out else []
-        if not lines:
-            return
-        try:
-            json.loads(lines[-1])
-        except ValueError:
-            return
-        with open(os.path.join(REPO, "_scratch", filename), "w") as fd:
-            fd.write(lines[-1] + "\n")
-
     # HEADLINE FIRST (learned 2026-07-31: a ~16 min up-window went entirely
     # to probes and the bench never touched the device before the next
     # wedge). The two north-star numbers — BENCH backend=tpu and
