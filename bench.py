@@ -240,35 +240,55 @@ def worker(n_tests, n_trees):
     configure_jax_cache()
 
     from flake16_framework_tpu import config as cfg, pipeline
-    from flake16_framework_tpu.parallel.sweep import SweepEngine
+    from flake16_framework_tpu.parallel import sweep
 
     feats, labels, projects, names, pids = make_data(n_tests)
     overrides = {"Random Forest": n_trees, "Extra Trees": n_trees}
-    engine = SweepEngine(feats, labels, projects, names, pids,
-                         tree_overrides=overrides,
-                         dispatch_trees=DISPATCH_TREES,
-                         dispatch_folds=DISPATCH_FOLDS)
+    # BENCH_BATCH=<B> runs same-family configs B-at-a-time through the
+    # config-batched SPMD path (run_config_batch; on one chip configs ride
+    # the within-shard vmap axis) instead of one run_config per config —
+    # the hw_probe rf_batch step measures whether batching amortizes the
+    # per-config cost on device. 0/unset keeps the per-config path.
+    batch_n = int(os.environ.get("BENCH_BATCH", "0"))
+    engine = sweep.SweepEngine(feats, labels, projects, names, pids,
+                               tree_overrides=overrides,
+                               dispatch_trees=DISPATCH_TREES,
+                               dispatch_folds=DISPATCH_FOLDS,
+                               mesh=sweep.default_mesh() if batch_n > 1
+                               else None)
 
-    # Warm-up: compile each family graph once (steady-state measurement —
+    def groups():
+        """CONFIGS grouped into batched/solo work units (shared grouping
+        helper — the same invariant run_grid's mesh path uses)."""
+        if batch_n <= 1:
+            return [[keys] for keys in CONFIGS]
+        return list(sweep.iter_family_batches(CONFIGS, batch_n))
+
+    def run_unit(unit):
+        if len(unit) == 1:
+            return [engine.run_config(unit[0])]
+        return engine.run_config_batch(unit)
+
+    # Warm-up: compile each work-unit shape once (steady-state measurement —
     # one compile serves all configs of a family across the full 216 grid).
     seen = set()
-    for keys in CONFIGS:
-        fam = (keys[1], keys[4])
-        if fam not in seen:
-            engine.run_config(keys)
-            seen.add(fam)
-            print(f"warmed {fam}", file=sys.stderr, flush=True)
+    for unit in groups():
+        shape = (unit[0][1], unit[0][4], len(unit))
+        if shape not in seen:
+            run_unit(unit)
+            seen.add(shape)
+            print(f"warmed {shape}", file=sys.stderr, flush=True)
 
     t0 = time.time()
     t_fit = t_pred = 0.0
     per_config = {}
-    for keys in CONFIGS:
-        res = engine.run_config(keys)
-        t_fit += res[0] * engine.n_folds
-        t_pred += res[1] * engine.n_folds
-        per_config["/".join(keys)] = round(
-            (res[0] + res[1]) * engine.n_folds, 3
-        )
+    for unit in groups():
+        for keys, res in zip(unit, run_unit(unit)):
+            t_fit += res[0] * engine.n_folds
+            t_pred += res[1] * engine.n_folds
+            per_config["/".join(keys)] = round(
+                (res[0] + res[1]) * engine.n_folds, 3
+            )
     t_scores = time.time() - t0
 
     # SHAP stage. Default impl "auto" = the Pallas kernel on TPU, XLA
@@ -292,6 +312,7 @@ def worker(n_tests, n_trees):
         "t_fit": round(t_fit, 3), "t_predict": round(t_pred, 3),
         "per_config_s": per_config,
         "dispatch_trees": DISPATCH_TREES,
+        "bench_batch": batch_n,
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -469,6 +490,7 @@ def main():
         t_ours_predict_s=result.get("t_predict"),
         per_config_s=result.get("per_config_s"),
         dispatch_trees=result.get("dispatch_trees"),
+        bench_batch=result.get("bench_batch"),
         scores_speedup=round(sum(t_base_scores) / result["t_scores"], 3)
         if result["t_scores"] else None,
         shap_speedup=round(sum(t_base_shap) / result["t_shap"], 3)

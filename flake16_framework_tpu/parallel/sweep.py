@@ -576,13 +576,17 @@ class SweepEngine:
         else:
             forest, xp, y = fit_b(*fit_args)
             jax.block_until_ready(forest)
-        t_train = (time.time() - t0) / b
+        # Attribute over the REAL configs, not the padded batch: padding
+        # duplicates are wasted work the real configs bear, and dividing by
+        # the padded size under-counts per-config time whenever the mesh has
+        # more devices than the batch has configs.
+        t_train = (time.time() - t0) / len(config_batch)
 
         t0 = time.time()
         counts = score_b(forest, xp, y, jnp.asarray(tems),
                          jnp.asarray(self.project_ids))
         counts = np.asarray(counts)
-        t_test = (time.time() - t0) / b
+        t_test = (time.time() - t0) / len(config_batch)
 
         out = []
         for i in range(len(config_batch)):
@@ -615,20 +619,28 @@ class SweepEngine:
                     progress(i + 1, len(todo), keys, scores)
             return scores
 
-        families = {}
-        for keys in todo:
-            families.setdefault((keys[1], keys[4]), []).append(keys)
-        d = self.mesh.devices.size
         done = 0
-        for fam_configs in families.values():
-            for lo in range(0, len(fam_configs), d):
-                batch = fam_configs[lo:lo + d]
-                for keys, res in zip(batch, self.run_config_batch(batch)):
-                    scores[keys] = res
-                    done += 1
-                    if progress is not None:
-                        progress(done, len(todo), keys, scores)
+        for batch in iter_family_batches(todo, self.mesh.devices.size):
+            for keys, res in zip(batch, self.run_config_batch(batch)):
+                scores[keys] = res
+                done += 1
+                if progress is not None:
+                    progress(done, len(todo), keys, scores)
         return scores
+
+
+def iter_family_batches(configs, batch_size):
+    """Group configs by family (feature set, model) and yield them in
+    batches of at most ``batch_size`` — the batching invariant shared by
+    ``run_grid``'s mesh path and bench.py's BENCH_BATCH mode (one
+    implementation, so the bench cannot diverge from the production
+    sweep's grouping)."""
+    families = {}
+    for keys in configs:
+        families.setdefault((keys[1], keys[4]), []).append(keys)
+    for fam_configs in families.values():
+        for lo in range(0, len(fam_configs), batch_size):
+            yield fam_configs[lo:lo + batch_size]
 
 
 def default_mesh(axis="config"):

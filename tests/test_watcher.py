@@ -81,3 +81,48 @@ def test_pick_tuned_env(tmp_path, monkeypatch):
     assert rw.pick_tuned_env(pos)["F16_HIST_NODE_BATCH"] == "192"
     # nothing parseable in the window -> empty env, not a crash
     assert rw.pick_tuned_env(path.stat().st_size) == {}
+
+
+def test_pick_tuned_env_batch_arm(tmp_path, monkeypatch):
+    """rf_full (per-config path) vs rf_batch (config-batched SPMD path):
+    the faster per-config steady decides BENCH_BATCH for the re-bench."""
+    rw = _load()
+    monkeypatch.setattr(rw, "REPO", str(tmp_path))
+    (tmp_path / "_scratch").mkdir()
+    path = tmp_path / "_scratch" / "hw_probe.jsonl"
+
+    def write(recs):
+        with open(path, "w") as fd:
+            for rec in recs:
+                fd.write(json.dumps(rec) + "\n")
+
+    # batch wins -> BENCH_BATCH=2
+    write([
+        {"step": "rf_full", "ok": True,
+         "out": ["compile_s 116.7", "steady_s 13.18", "stages {...}"]},
+        {"step": "rf_batch", "ok": True,
+         "out": ["compile_s 120.0", "steady_s 8.0 per_config_s 4.0 (2 configs)"]},
+    ])
+    assert rw.pick_tuned_env(0).get("BENCH_BATCH") == "2"
+    # per-config path wins -> no BENCH_BATCH key
+    write([
+        {"step": "rf_full", "ok": True,
+         "out": ["compile_s 10.0", "steady_s 1.0"]},
+        {"step": "rf_batch", "ok": True,
+         "out": ["compile_s 12.0", "steady_s 8.0 per_config_s 4.0 (2 configs)"]},
+    ])
+    assert "BENCH_BATCH" not in rw.pick_tuned_env(0)
+    # the knob mirrors the batch size the probe actually measured
+    write([
+        {"step": "rf_full", "ok": True, "out": ["steady_s 13.0"]},
+        {"step": "rf_batch", "ok": True,
+         "out": ["steady_s 12.0 per_config_s 3.0 (4 configs)"]},
+    ])
+    assert rw.pick_tuned_env(0).get("BENCH_BATCH") == "4"
+    # a failed rf_batch record is ignored
+    write([
+        {"step": "rf_batch", "ok": False,
+         "out": ["steady_s 0.1 per_config_s 0.05 (2 configs)"]},
+        {"step": "rf_full", "ok": True, "out": ["steady_s 5.0"]},
+    ])
+    assert "BENCH_BATCH" not in rw.pick_tuned_env(0)

@@ -60,8 +60,12 @@ eng = make_engine()
 import time
 keys = ('NOD', 'Flake16', 'Scaling', 'SMOTE', 'Random Forest')
 t0 = time.time(); eng.run_config(keys); print('compile_s', round(time.time() - t0, 2))
+# steady_s comes from an UNTIMED pass: it feeds the rf_batch comparison
+# (pick_tuned_env), and timed mode's extra per-stage syncs would inflate
+# it by several tunnel round trips. The timed attribution pass follows.
+t0 = time.time(); r = eng.run_config(keys); print('steady_s', round(time.time() - t0, 2))
 tm = {}
-t0 = time.time(); r = eng.run_config(keys, timings=tm); print('steady_s', round(time.time() - t0, 2))
+eng.run_config(keys, timings=tm)
 print('stages', tm)
 """,
     # PCA prep ALONE (device default = Gram eigh) — attributes any wedge
@@ -114,6 +118,27 @@ t0 = time.time(); mu, w = jax.block_until_ready(fn(x, jnp.int32(PREP_PCA)))
 print('pca_svd_compile_s', round(time.time() - t0, 2))
 t0 = time.time(); mu, w = jax.block_until_ready(fn(x, jnp.int32(PREP_PCA)))
 print('pca_svd_steady_s', round(time.time() - t0, 3))
+""",
+    # Config-batched SPMD path (run_config_batch / shard_map) on a
+    # 1-device mesh: TWO same-family RF configs ride the within-shard vmap
+    # axis of ONE program. Proves the production sharded path on real
+    # silicon (virtual-CPU meshes only, until now) and measures whether
+    # batching amortizes the per-config cost rf_full can't attribute
+    # (13.18 s steady vs ~0 s growth chunks, 2026-07-31).
+    "rf_batch": """
+from probe_common import make_engine
+import time
+eng = make_engine(mesh=True)
+batch = [('NOD', 'Flake16', 'Scaling', 'SMOTE', 'Random Forest'),
+         ('OD', 'Flake16', 'Scaling', 'SMOTE', 'Random Forest')]
+t0 = time.time(); eng.run_config_batch(batch)
+print('compile_s', round(time.time() - t0, 2))
+t0 = time.time(); r = eng.run_config_batch(batch)
+w = time.time() - t0
+print('steady_s', round(w, 2),
+      'per_config_s', round(w / len(batch), 2),
+      '(%d configs)' % len(batch))
+print('totals', [x[3][:3] for x in r])
 """,
     # ET WITHOUT PCA (the bench's ENN config) — separates ET-grower cost
     # from PCA cost on device.
@@ -171,7 +196,8 @@ for line in predict_ab():
 # prep_pca runs early — cheap, and it attributes a PCA-stage wedge by
 # name. prep_pca_svd is deliberately absent (opt-in).
 DEFAULT_STEPS = ["matmul", "prep_pca", "dt", "rf_chunk", "rf_full",
-                 "et_enn", "shap", "shap_equiv", "predict_ab", "et_full"]
+                 "rf_batch", "et_enn", "shap", "shap_equiv", "predict_ab",
+                 "et_full"]
 
 
 # Every step reports the backend jax ACTUALLY initialized — authoritative

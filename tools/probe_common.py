@@ -22,13 +22,15 @@ DISPATCH = bench.DISPATCH_TREES
 N_EXPLAIN = min(bench.SHAP_EXPLAIN, N_TESTS)
 
 
-def make_engine():
-    from flake16_framework_tpu.parallel.sweep import SweepEngine
+def make_engine(mesh=False):
+    from flake16_framework_tpu.parallel import sweep
 
     feats, labels, projects, names, pids = bench.make_data(N_TESTS)
     overrides = {"Random Forest": N_TREES, "Extra Trees": N_TREES}
-    return SweepEngine(feats, labels, projects, names, pids,
-                       tree_overrides=overrides, dispatch_trees=DISPATCH)
+    return sweep.SweepEngine(
+        feats, labels, projects, names, pids, tree_overrides=overrides,
+        dispatch_trees=DISPATCH,
+        mesh=sweep.default_mesh() if mesh else None)
 
 
 def chunk_fit_times(config_keys):

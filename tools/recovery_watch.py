@@ -156,6 +156,25 @@ def pick_tuned_env(since_pos):
                     else:
                         consider("dispatch", per_tree, env_frag or
                                  {"BENCH_DISPATCH_TREES": tag.rsplit("d", 1)[1]})
+                elif tag == "rf_full":
+                    # One "batch" kind, two arms: per-config path (empty
+                    # env = no batching) vs the config-batched SPMD path
+                    # below; min per-config steady wins the re-bench knob.
+                    try:
+                        steady = float(
+                            out.split("steady_s ", 1)[1].split()[0])
+                    except (IndexError, ValueError):
+                        continue
+                    consider("batch", steady, {})
+                elif tag == "rf_batch":
+                    # "per_config_s X (N configs)" — N is parsed so the
+                    # knob always matches the batch size the probe measured.
+                    try:
+                        part = out.split("per_config_s ", 1)[1].split()
+                        steady, n_cfg = float(part[0]), int(part[1].strip("("))
+                    except (IndexError, ValueError):
+                        continue
+                    consider("batch", steady, {"BENCH_BATCH": str(n_cfg)})
                 elif tag.startswith("shap_"):
                     try:
                         steady = float(
@@ -241,14 +260,16 @@ def chain():
     # each step x 600 s worst case + slack, so cold compiles on every step
     # still reach the deliberately-last et_full (hw_probe stops at the
     # first failure anyway).
+    # pick_tuned_env reads everything from HERE on: the probe_all records
+    # (rf_full vs rf_batch — the batching arm) as well as the tune sweeps.
+    probe_log = os.path.join(REPO, "_scratch", "hw_probe.jsonl")
+    tune_from = os.path.getsize(probe_log) if os.path.exists(probe_log) else 0
     probe_steps = [s for s in hw_probe_default_steps() if s != "matmul"]
     ok, _ = run_stage("probe_all", [py, probe] + probe_steps,
                       600 * len(probe_steps) + 1800)
     if not ok and not listener_up():
         return False
     # 6 tune_hist + 10 tune_shap combos x 600 s worst case each, plus slack
-    probe_log = os.path.join(REPO, "_scratch", "hw_probe.jsonl")
-    tune_from = os.path.getsize(probe_log) if os.path.exists(probe_log) else 0
     ok_tune, _ = run_stage("tune", [py, probe, "tune_hist", "tune_shap"],
                            12600)
     if not ok_tune and not listener_up():
