@@ -97,14 +97,24 @@ def _dump(obj, path):
 def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
                     tree_overrides=None, seed=0, sample_chunk=512,
                     impl="auto", n_explain=None, shap_tree_chunk=None,
-                    fit_dispatch_trees=None):
+                    fit_dispatch_trees=None, timings=None):
     """One SHAP config (reference get_shap experiment.py:504-517): preprocess
     full data, fit on the balanced full set, explain every original sample
     (or the first ``n_explain`` — benchmark sizing). Returns the class-0
     values array [N, F'] (the reference's ``shap_values(features)[0]``
     convention). ``impl`` selects the Tree SHAP backend (ops/treeshap.py:
     "pallas" kernel / "xla" / "auto"); ``shap_tree_chunk`` splits the explain
-    into per-tree-slice dispatches (treeshap.forest_shap_class0)."""
+    into per-tree-slice dispatches (treeshap.forest_shap_class0).
+    ``timings``: optional dict filled with per-stage walls (prep/resample/
+    fit/explain; extra device syncs in timed mode only — the TPU probe's
+    attribution instrument, same shape as SweepEngine.run_config)."""
+    def _mark(stage, t0, *sync):
+        if timings is not None:
+            for v in sync:
+                jax.block_until_ready(v)
+            timings[stage] = round(time.time() - t0, 4)
+        return time.time()
+
     fl, cols, prep, bal, spec = cfg.resolve_config(config_keys)
     if tree_overrides and spec.name in tree_overrides:
         spec = type(spec)(spec.name, tree_overrides[spec.name], spec.bootstrap,
@@ -115,11 +125,14 @@ def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
     n = x.shape[0]
 
     key = jax.random.PRNGKey(seed)
+    t0 = time.time()
     mu, wmat = jax.jit(fit_preprocess)(x, prep)
     xp = transform(x, mu, wmat)
+    t0 = _mark("prep_s", t0, xp)
 
     kb, kf = jax.random.split(key)
     xs, ys, ws = resample(xp, y, np.ones(n, np.float32), bal, kb, 2 * n)
+    t0 = _mark("resample_s", t0, xs)
     fit_kw = dict(
         n_trees=spec.n_trees, bootstrap=spec.bootstrap,
         random_splits=spec.random_splits, sqrt_features=spec.sqrt_features,
@@ -150,12 +163,15 @@ def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
             forest = trees.fit_forest_hist(xs, ys, ws, kf, **fit_kw)
     else:
         forest = trees.fit_forest(xs, ys, ws, kf, **fit_kw)
+    t0 = _mark("fit_s", t0, forest)
     x_explain = xp if n_explain is None else xp[:n_explain]
-    return np.asarray(
+    out = np.asarray(
         treeshap.forest_shap_class0(forest, x_explain,
                                     sample_chunk=sample_chunk, impl=impl,
                                     tree_chunk=shap_tree_chunk)
     )
+    _mark("explain_s", t0)
+    return out
 
 
 def write_shap(tests_file=TESTS_FILE, out_file=SHAP_FILE, *, max_depth=48,

@@ -112,3 +112,19 @@ def test_shap_fit_dispatch_chunking_is_exact():
     b = pipeline.shap_for_config(keys, feats, labels, fit_dispatch_trees=2,
                                  **kw)
     np.testing.assert_array_equal(a, b)
+
+
+def test_shap_timed_mode_is_results_neutral():
+    # timings= fills the per-stage attribution dict (the TPU probe's
+    # instrument) without changing the explanation bit-for-bit.
+    from flake16_framework_tpu import pipeline
+    from flake16_framework_tpu.utils.synth import make_dataset
+
+    feats, labels, _ = make_dataset(n_tests=150, seed=3)
+    keys = ("NOD", "Flake16", "Scaling", "SMOTE Tomek", "Extra Trees")
+    kw = dict(tree_overrides={"Extra Trees": 5}, n_explain=40, impl="xla")
+    plain = pipeline.shap_for_config(keys, feats, labels, **kw)
+    tm = {}
+    timed = pipeline.shap_for_config(keys, feats, labels, timings=tm, **kw)
+    np.testing.assert_array_equal(plain, timed)
+    assert {"prep_s", "resample_s", "fit_s", "explain_s"} <= set(tm)
