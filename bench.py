@@ -232,6 +232,29 @@ def configure_jax_cache():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
+def make_bench_engine(feats, labels, projects, names, pids, n_trees):
+    """The bench's SweepEngine under the bench env knobs, shared with
+    tools/grid_tpu.py so the grid measures exactly the engine the bench
+    does. Returns (engine, batch_n).
+
+    BENCH_BATCH=<B> runs same-family configs B-at-a-time through the
+    config-batched SPMD path (run_config_batch; on one chip configs ride
+    the within-shard vmap axis) instead of one run_config per config —
+    the hw_probe rf_batch step measures whether batching amortizes the
+    per-config cost on device. 0/unset keeps the per-config path."""
+    from flake16_framework_tpu.parallel import sweep
+
+    overrides = {"Random Forest": n_trees, "Extra Trees": n_trees}
+    batch_n = int(os.environ.get("BENCH_BATCH", "0"))
+    engine = sweep.SweepEngine(feats, labels, projects, names, pids,
+                               tree_overrides=overrides,
+                               dispatch_trees=DISPATCH_TREES,
+                               dispatch_folds=DISPATCH_FOLDS,
+                               mesh=sweep.default_mesh() if batch_n > 1
+                               else None)
+    return engine, batch_n
+
+
 def worker(n_tests, n_trees):
     """Subprocess body: run the jitted scores probe + the 2 SHAP configs on
     the default backend; print one JSON line with steady-state timings."""
@@ -244,18 +267,8 @@ def worker(n_tests, n_trees):
 
     feats, labels, projects, names, pids = make_data(n_tests)
     overrides = {"Random Forest": n_trees, "Extra Trees": n_trees}
-    # BENCH_BATCH=<B> runs same-family configs B-at-a-time through the
-    # config-batched SPMD path (run_config_batch; on one chip configs ride
-    # the within-shard vmap axis) instead of one run_config per config —
-    # the hw_probe rf_batch step measures whether batching amortizes the
-    # per-config cost on device. 0/unset keeps the per-config path.
-    batch_n = int(os.environ.get("BENCH_BATCH", "0"))
-    engine = sweep.SweepEngine(feats, labels, projects, names, pids,
-                               tree_overrides=overrides,
-                               dispatch_trees=DISPATCH_TREES,
-                               dispatch_folds=DISPATCH_FOLDS,
-                               mesh=sweep.default_mesh() if batch_n > 1
-                               else None)
+    engine, batch_n = make_bench_engine(feats, labels, projects, names, pids,
+                                        n_trees)
 
     def groups():
         """CONFIGS grouped into batched/solo work units (shared grouping

@@ -597,7 +597,8 @@ class SweepEngine:
                         scores, scores_total])
         return out
 
-    def run_grid(self, config_list=None, ledger=None, progress=None):
+    def run_grid(self, config_list=None, ledger=None, progress=None,
+                 batch_size=None):
         """Run many configs; returns {config_keys: [t_train, t_test, scores,
         scores_total]}. ``ledger`` is a dict of already-done configs to skip
         (per-config resume, unlike the reference). ``progress`` receives
@@ -606,13 +607,19 @@ class SweepEngine:
 
         With a mesh attached, same-family configs are batched across the
         "config" mesh axis (the ICI analog of the reference's process pool);
-        without one, configs run one jitted step at a time."""
+        without one, configs run one jitted step at a time. ``batch_size``
+        overrides the batch width (default: the mesh device count) — on a
+        single chip a width >1 still batches configs onto the within-shard
+        vmap axis (the BENCH_BATCH mode); leftover singleton batches go
+        through the per-config path."""
         scores = dict(ledger or {})
         if config_list is None:
             config_list = cfg.iter_config_keys()
         todo = [tuple(k) for k in config_list if tuple(k) not in scores]
 
-        if self.mesh is None or self.mesh.devices.size <= 1:
+        b = batch_size if batch_size is not None else (
+            self.mesh.devices.size if self.mesh is not None else 1)
+        if self.mesh is None or b <= 1:
             for i, keys in enumerate(todo):
                 scores[keys] = self.run_config(keys)
                 if progress is not None:
@@ -620,8 +627,10 @@ class SweepEngine:
             return scores
 
         done = 0
-        for batch in iter_family_batches(todo, self.mesh.devices.size):
-            for keys, res in zip(batch, self.run_config_batch(batch)):
+        for batch in iter_family_batches(todo, b):
+            results = (self.run_config_batch(batch) if len(batch) > 1
+                       else [self.run_config(batch[0])])
+            for keys, res in zip(batch, results):
                 scores[keys] = res
                 done += 1
                 if progress is not None:

@@ -1,0 +1,103 @@
+"""Full 216-config grid on the default backend with a resumable ledger.
+
+The virtual-mesh full-grid runs (PROFILE.md: 220 s / 372 s walls on 8 CPU
+devices) prove capability; this is the same sweep pointed at the real chip
+— the north-star's scores stage at grid scale on silicon. Designed for the
+flaky tunnel: the ledger checkpoint persists after EVERY config, so a
+device wedge mid-grid costs nothing — the next up-window resumes where
+this one died.
+
+    python tools/grid_tpu.py            # bench-size data, full grid
+    F16_GRID_CONFIGS=24 ...             # first N grid configs only
+
+Knob env (BENCH_DISPATCH_TREES, F16_HIST_NODE_BATCH, BENCH_BATCH, ...) is
+honored the same way the bench honors it, so the watcher can run this
+under the tune winners. One JSON line per run lands in
+_scratch/grid_tpu.jsonl; the ledger lives in _scratch/grid_tpu_ledger.pkl.
+"""
+
+import json
+import os
+import pickle
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+LEDGER = os.path.join(REPO, "_scratch", "grid_tpu_ledger.pkl")
+OUT = os.path.join(REPO, "_scratch", "grid_tpu.jsonl")
+
+
+def main():
+    import jax
+
+    import bench
+    from flake16_framework_tpu import config as cfg
+
+    bench.configure_jax_cache()
+    feats, labels, projects, names, pids = bench.make_data(bench.N_TESTS)
+    engine, batch_n = bench.make_bench_engine(
+        feats, labels, projects, names, pids, bench.N_TREES)
+
+    grid = list(cfg.iter_config_keys())
+    limit = int(os.environ.get("F16_GRID_CONFIGS", "0"))
+    if limit:
+        grid = grid[:limit]
+
+    # The ledger only resumes runs of the SAME experiment. The gate holds
+    # exactly the RESULT-affecting parameters: data size, ensemble size,
+    # backend (config tuples alone would silently resume a tiny-size CPU
+    # dry run's scores into a full-size TPU record). Dispatch/batch/width
+    # knobs are results-neutral by test-pinned design, so tune-winner
+    # churn between up-windows does NOT invalidate accumulated progress;
+    # each run's knob values are recorded in its jsonl line instead.
+    meta = {"n_tests": bench.N_TESTS, "n_trees": bench.N_TREES,
+            "backend": jax.default_backend()}
+    saved_scores = {}
+    if os.path.exists(LEDGER):
+        with open(LEDGER, "rb") as fd:
+            saved = pickle.load(fd)
+        if saved.get("meta") == meta:
+            saved_scores = saved["scores"]
+        else:
+            print(f"ledger meta mismatch (saved {saved.get('meta')} vs "
+                  f"{meta}) — starting fresh", file=sys.stderr)
+    # run_grid only needs the subset covering this (possibly
+    # F16_GRID_CONFIGS-limited) grid; the checkpoint below always merges
+    # into the FULL saved dict so a limited smoke run can never destroy
+    # full-grid progress.
+    ledger = {k: v for k, v in saved_scores.items() if k in set(grid)}
+    done_at_start = len(ledger)
+
+    def checkpoint(i, total, keys, live):
+        with open(LEDGER + ".tmp", "wb") as fd:
+            pickle.dump({"meta": meta, "scores": {**saved_scores, **live}},
+                        fd)
+        os.replace(LEDGER + ".tmp", LEDGER)
+        print(f"[{done_at_start + i}/{len(grid)}] {'/'.join(keys)}",
+              file=sys.stderr, flush=True)
+
+    t0 = time.time()
+    scores = engine.run_grid(grid, ledger=ledger, progress=checkpoint,
+                             batch_size=batch_n if batch_n > 1 else None)
+    wall = time.time() - t0
+
+    rec = {
+        "step": "grid_tpu", "backend": jax.default_backend(),
+        "n_tests": bench.N_TESTS, "n_trees": bench.N_TREES,
+        "configs_total": len(grid), "configs_done_before": done_at_start,
+        "configs_run_now": len(grid) - done_at_start,
+        "wall_s": round(wall, 1),
+        "per_config_s": round(wall / max(len(grid) - done_at_start, 1), 2),
+        "dispatch_trees": bench.DISPATCH_TREES, "bench_batch": batch_n,
+        "defined_f1": sum(1 for v in scores.values() if v[3][-1] is not None),
+    }
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as fd:
+        fd.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

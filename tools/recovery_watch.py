@@ -286,6 +286,12 @@ def chain():
             return False
     run_stage("trace", [py, os.path.join(REPO, "tools", "hw_trace.py"),
                         "fit", "shap"], 1800, env_extra=tuned or None)
+    # LAST, after every other piece of evidence is banked: the full
+    # 216-config grid on the real chip under the tune winners. Its ledger
+    # checkpoints after every config and is meta-stamped, so a wedge
+    # mid-grid costs nothing — the next window's chain resumes it.
+    run_stage("grid", [py, os.path.join(REPO, "tools", "grid_tpu.py")],
+              10800, env_extra=tuned or None)
     set_status(state="done", bench_ok=ok_b, parity_ok=ok_p,
                tuned=tuned or None)
     return True
