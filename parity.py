@@ -267,9 +267,15 @@ def main():
             n_tests=4000, n_trees=100, k_ours=6, k_sk=6,
             sklearn_cache=os.environ.get("PARITY_SKLEARN_CACHE"),
         )
+        import jax
+
         tol = 0.01
         out = {"tier": "full", "n_tests": 4000, "n_trees": 100,
                "tolerance": tol, "configs": rep,
+               # provenance: results are backend-independent by design
+               # (bit-pinned hist formulations, backend-deterministic PRNG)
+               # but the record must say which backend ran the ours side
+               "ours_backend": jax.default_backend(),
                "ok": all(abs(v["delta"]) <= tol for v in rep.values())}
         with open(os.path.join(REPO, "PARITY.json"), "w") as fd:
             json.dump(out, fd, indent=2)
