@@ -479,9 +479,10 @@ def _fit_one_tree(x, y01, w, key, order0, xsorted, *, random_splits,
 # split discretization washes out in the ensemble average (parity budget
 # BASELINE.md: F1 +/- 0.01); the single DecisionTree config keeps the exact
 # grower. ExtraTrees randomness: sklearn draws thresholds uniformly over the
-# node's value range; here the draw is uniform over the node's occupied bin
-# boundaries (rank-space rather than value-space uniform) — covered by the
-# same ensemble parity budget.
+# node's value range; here the draw is uniform in VALUE space over the
+# node's occupied bin span, rounded to bin resolution (F16_ET_DRAW=rank
+# restores the round-2 boundary-index draw — the parity investigation
+# measured it low on the PCA probe config).
 # --------------------------------------------------------------------------
 
 # Histogram-grower tuning knobs. Env-overridable (read at import) so the
@@ -501,6 +502,14 @@ HIST_BINS = int(os.environ.get("F16_HIST_BINS", "64"))
 # pins it.
 HIST_NODE_BATCH = int(os.environ.get("F16_HIST_NODE_BATCH", "128"))
 HIST_NODE_BATCH_CPU = int(os.environ.get("F16_HIST_NODE_BATCH_CPU", "0"))
+# ExtraTrees threshold-draw space in the hist grower: "value" (sklearn's
+# uniform over the node's value range, rounded to bin resolution — the
+# default since round 3's parity investigation) or "rank" (uniform over
+# occupied boundary indices — the round-2 behavior). Unlike the width
+# knobs this IS model-changing; it exists for the parity A/B.
+ET_DRAW = os.environ.get("F16_ET_DRAW", "value")
+if ET_DRAW not in ("value", "rank"):  # a typo'd A/B arm must fail loudly
+    raise ValueError(f"F16_ET_DRAW must be value|rank, got {ET_DRAW!r}")
 
 
 def _cpu_node_batch(max_nodes):
@@ -619,16 +628,42 @@ def _fit_one_tree_hist(ohfb, bin_idx, edges, y01, w, key, *, random_splits,
         nc = jnp.any(valid, axis=-1)                   # [F, W] non-constant
 
         if random_splits:
-            # ExtraTrees: boundary drawn uniformly over the node's occupied
-            # range [lo+1, hi] (lo/hi = first/last nonzero bin).
+            # ExtraTrees: sklearn draws the threshold uniformly over the
+            # node's VALUE range (the exact grower replicates it directly,
+            # trees.py _fit_one_tree). Binned twin: the node's span comes
+            # from its occupied bins' edge values (end bins extrapolate one
+            # neighbor width), the draw is uniform in value space, and the
+            # drawn value rounds down to its bin's lower boundary — so the
+            # boundary distribution weights each bin by its VALUE width,
+            # converging to sklearn's draw as bins densify. Round-3 parity
+            # data motivated the switch: the rank-space draw (uniform over
+            # boundary indices, value-width-blind; F16_ET_DRAW=rank
+            # restores it) read low on the PCA probe config. All index
+            # arithmetic stays in the tiny [F, W] space via one-hot
+            # reductions — no per-sample gathers.
             occ = hw > 0
             lo = jnp.argmax(occ, axis=-1)              # [F, W]
             hi = n_bins - 1 - jnp.argmax(jnp.flip(occ, -1), axis=-1)
-            span = jnp.maximum(hi - lo, 1)
             u = jax.vmap(
                 lambda k: jax.random.uniform(k, (n_feat,), dtype=dt)
             )(kt).T                                    # [F, W], per-node keys
-            bsel = lo + 1 + jnp.floor(u * span).astype(jnp.int32)
+            if ET_DRAW == "rank" or n_bins < 3:
+                # (n_bins=2 has a single boundary — no width information to
+                # weight; the rank draw is exact there anyway)
+                span = jnp.maximum(hi - lo, 1)
+                bsel = lo + 1 + jnp.floor(u * span).astype(jnp.int32)
+            else:
+                first = edges[:, :1] - (edges[:, 1:2] - edges[:, :1])
+                last = edges[:, -1:] + (edges[:, -1:] - edges[:, -2:-1])
+                full = jnp.concatenate([first, edges, last], 1)  # [F, B+1]
+                oh_lo = jax.nn.one_hot(lo, n_bins + 1, dtype=dt)
+                oh_hi = jax.nn.one_hot(hi + 1, n_bins + 1, dtype=dt)
+                vmin = jnp.sum(oh_lo * full[:, None, :], -1)     # [F, W]
+                vmax = jnp.sum(oh_hi * full[:, None, :], -1)
+                thr_v = vmin + u * (vmax - vmin)
+                cnt = jnp.sum(edges[:, None, :] < thr_v[:, :, None],
+                              axis=-1).astype(jnp.int32)
+                bsel = jnp.clip(cnt, lo + 1, hi)
             ohb = jax.nn.one_hot(bsel - 1, n_bins - 1, dtype=jnp.float32)
             lw_j = jnp.sum(lw * ohb, -1)
             lwy_j = jnp.sum(lwy * ohb, -1)
