@@ -62,7 +62,11 @@ def _pca_basis(xc, pca_impl):
     if impl == "svd":
         _, _, vt = jnp.linalg.svd(xc, full_matrices=False)
         return vt
-    _, evecs = jnp.linalg.eigh(xc.T @ xc)
+    # HIGHEST precision: the default TPU matmul runs bf16 passes, and the Gram
+    # product is the only place the eigh arm can drift from the f32 LAPACK
+    # convention the parity tests pin — the [F,N]@[N,F] product is tiny.
+    gram = jnp.matmul(xc.T, xc, precision=lax.Precision.HIGHEST)
+    _, evecs = jnp.linalg.eigh(gram)
     return evecs[:, ::-1].T
 
 
@@ -91,7 +95,10 @@ def fit_preprocess(x, prep_code, pca_impl=None):
         vt = _pca_basis(xc, pca_impl)
         # svd_flip(u_based): sign from U's max-|.| row; U column = Xc @ v / s,
         # so sign(U[i,j]) == sign((Xc @ vt[j])[i]) and we avoid materializing U.
-        proj = xc @ vt.T  # [N, F] = U * S
+        # [N, F] = U * S; HIGHEST so the TPU argmax/sign decision below reads
+        # the same projections the CPU arm computes (bf16 passes can flip the
+        # winner between two near-equal |proj| entries).
+        proj = jnp.matmul(xc, vt.T, precision=lax.Precision.HIGHEST)
         idx = jnp.argmax(jnp.abs(proj), axis=0)
         signs = jnp.sign(proj[idx, jnp.arange(f)])
         signs = jnp.where(signs == 0, 1.0, signs)

@@ -267,13 +267,18 @@ def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
     Two chunk axes, composable with either alone:
     - ``dc`` slices the per-tree key table (``tree_axis``) — the ensemble
       bound;
-    - ``fold_chunk`` slices the fold axis (axis 0 of the prepped tensors;
-      single-device path only) — the bound for single-tree models, whose
-      whole fit is ``n_folds`` concurrent tree growths in one dispatch.
+    - ``fold_chunk`` slices the fold axis (axis 0 of the prepped tensors on
+      the single-device path, axis 1 on the mesh-batched path) — the bound
+      for single-tree models, whose whole fit is ``n_folds`` concurrent
+      tree growths in one dispatch. Each distinct fold-slice shape is one
+      extra compile of the chunk program.
     """
-    assert fold_chunk is None or tree_axis == 1, (
-        "fold_chunk applies to the single-device path only"
-    )
+    fold_axis = 0 if tree_axis == 1 else 1
+
+    def fsl(a, flo, fhi):
+        if flo == 0 and fhi >= a.shape[fold_axis]:
+            return a  # full range: no slice op for XLA to copy
+        return a[flo:fhi] if fold_axis == 0 else a[:, flo:fhi]
 
     def run_bounded(thunk):
         """Dispatch + block, retrying ONCE on a transient device fault.
@@ -305,7 +310,10 @@ def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
     t0 = time.time()
     xs, ys, ws, edges, xp, y = prep_fn(*fit_args)
     if timings is not None:
-        jax.block_until_ready(xs)
+        # Block on the FULL prep output, not just xs — the other outputs
+        # may still be executing and their device time would otherwise be
+        # misattributed to tree_keys_s or the first chunk.
+        jax.block_until_ready((xs, ys, ws, edges, xp, y))
         timings["prep_s"] = round(time.time() - t0, 4)
     t0 = time.time()
     tks = tree_keys_thunk()
@@ -313,7 +321,7 @@ def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
         jax.block_until_ready(tks)
         timings["tree_keys_s"] = round(time.time() - t0, 4)
         timings["chunks_s"] = []
-    n_folds = xs.shape[0]
+    n_folds = xs.shape[fold_axis]
     step = dc if dc is not None else n_trees
     if fold_chunk is not None and fold_chunk < n_folds:
         fold_ranges = [(flo, min(flo + fold_chunk, n_folds))
@@ -323,17 +331,17 @@ def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
 
     fold_parts = []
     for flo, fhi in fold_ranges:
+        xsf, ysf, wsf = (fsl(a, flo, fhi) for a in (xs, ys, ws))
         parts = []
         for lo in range(0, n_trees, step):
             t0 = time.time()
             if tree_axis == 1:  # single-device: tensors [folds, ...]
                 forest_c = run_bounded(lambda: fit_chunk_fn(
-                    xs[flo:fhi], ys[flo:fhi], ws[flo:fhi], edges,
-                    tks[flo:fhi, lo:lo + step],
+                    xsf, ysf, wsf, edges, tks[flo:fhi, lo:lo + step],
                 ))
             else:               # mesh batch: tensors [B, folds, ...]
                 forest_c = run_bounded(lambda: fit_chunk_fn(
-                    xs, ys, ws, edges, tks[:, :, lo:lo + step],
+                    xsf, ysf, wsf, edges, tks[:, flo:fhi, lo:lo + step],
                 ))
             if timings is not None:  # run_bounded already blocked
                 timings["chunks_s"].append(round(time.time() - t0, 4))
@@ -343,13 +351,13 @@ def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
     if len(fold_parts) == 1:
         forest = fold_parts[0]
     else:
-        # Axis 0 here is the FOLD axis, so the fold-broadcast max_depth
-        # (shape [fold_chunk]) must be concatenated along with the tree
-        # fields (concat_trees leaves it alone by design — it has no tree
-        # axis).
-        forest = trees.concat_trees(fold_parts, axis=0)._replace(
+        # Concatenating along the FOLD axis, so the fold-broadcast
+        # max_depth (shape [fold_chunk] / [B, fold_chunk]) must be
+        # concatenated along with the tree fields (concat_trees leaves it
+        # alone by design — it has no tree axis).
+        forest = trees.concat_trees(fold_parts, axis=fold_axis)._replace(
             max_depth=jnp.concatenate(
-                [p.max_depth for p in fold_parts])
+                [p.max_depth for p in fold_parts], axis=fold_axis)
         )
     t0 = time.time()
     jax.block_until_ready(forest)
@@ -387,14 +395,9 @@ class SweepEngine:
         # dispatch_folds splits the fold axis (the bound that matters for
         # single-tree models, where one dispatch is n_folds tree growths).
         self.dispatch_trees = dispatch_trees
-        if (dispatch_folds is not None and mesh is not None
-                and mesh.devices.size > 1):
-            # run_config_batch keeps the fold axis inside each shard; a
-            # silently-ignored bound would defeat its purpose.
-            raise ValueError(
-                "dispatch_folds is a single-device knob; the mesh-batched "
-                "path only supports dispatch_trees"
-            )
+        # Both bounds apply on both paths: run_config_batch fold-slices
+        # axis 1 of its [B, folds, ...] shard tensors the same way
+        # run_config slices axis 0 (_chunked_fit fold_axis).
         self.dispatch_folds = dispatch_folds
         # tests shrink ensembles: {"Random Forest": 10, ...}
         self.tree_overrides = tree_overrides or {}
@@ -448,6 +451,19 @@ class SweepEngine:
             )
         return self._fns[key]
 
+    def _dispatch_bounds(self, n_trees):
+        """Effective (dispatch_trees, dispatch_folds) for one run — a bound
+        that already covers its whole axis is no bound (None = single
+        dispatch). One place, so the single-device and mesh paths cannot
+        diverge on the gating rules."""
+        dc = self.dispatch_trees
+        if dc is not None and n_trees <= dc:
+            dc = None
+        df = self.dispatch_folds
+        if df is not None and self.n_folds <= df:
+            df = None
+        return dc, df
+
     def run_config(self, config_keys, timings=None):
         """Run one config; returns (t_train, t_test, scores, scores_total)
         in the reference scores.pkl value schema (README.rst:78-134).
@@ -471,12 +487,7 @@ class SweepEngine:
             key, jnp.asarray(train_mask),
         )
         n_trees = self._spec(model_name).n_trees
-        dc = self.dispatch_trees
-        if dc is not None and n_trees <= dc:
-            dc = None
-        df = self.dispatch_folds
-        if df is not None and self.n_folds <= df:
-            df = None
+        dc, df = self._dispatch_bounds(n_trees)
 
         t0 = time.time()
         if dc is not None or df is not None:
@@ -563,15 +574,15 @@ class SweepEngine:
             jnp.asarray(trms),
         )
         n_trees = self._spec(model_name).n_trees
-        dc = self.dispatch_trees
+        dc, df = self._dispatch_bounds(n_trees)
 
         t0 = time.time()
-        if dc is not None and n_trees > dc:
+        if dc is not None or df is not None:
             # Same dispatch-bounding as run_config, but SPMD over the mesh:
             # every chunk dispatch is one shard_map program.
             forest, xp, y = _chunked_fit(
                 prep_b, fit_chunk_b, lambda: tree_keys_b(jnp.asarray(keys)),
-                fit_args, n_trees, dc, tree_axis=2,
+                fit_args, n_trees, dc, tree_axis=2, fold_chunk=df,
             )
         else:
             forest, xp, y = fit_b(*fit_args)

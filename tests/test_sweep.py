@@ -209,6 +209,18 @@ def test_sharded_dispatch_chunked_matches_unchunked():
         assert a[keys][3] == b[keys][3], keys
         assert a[keys][2] == b[keys][2], keys
 
+    # dispatch_folds composes on the mesh path too (fold axis 1 of the
+    # [B, folds, ...] shard tensors) — previously it was silently ignored
+    # there (ADVICE r3), so pin the bit-identity, not just the no-crash.
+    fold_chunked = sweep.SweepEngine(feats, labels, projects, names, pids,
+                                     mesh=sweep.default_mesh(),
+                                     dispatch_trees=4, dispatch_folds=4,
+                                     **common)  # 10 folds -> 4+4+2
+    c = fold_chunked.run_grid(configs)
+    for keys in configs:
+        assert a[keys][3] == c[keys][3], keys
+        assert a[keys][2] == c[keys][2], keys
+
 
 def test_fold_chunked_fit_matches_single_dispatch(engine):
     # dispatch_folds bounds the single-tree (DT) fit, whose whole dispatch

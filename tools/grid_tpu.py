@@ -25,8 +25,20 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-LEDGER = os.path.join(REPO, "_scratch", "grid_tpu_ledger.pkl")
+LEDGER_BASE = os.path.join(REPO, "_scratch", "grid_tpu_ledger")
 OUT = os.path.join(REPO, "_scratch", "grid_tpu.jsonl")
+
+
+def ledger_path(meta):
+    """Per-meta ledger file. Keying the filename on the result-affecting
+    parameters means a run under a DIFFERENT meta (the documented failure
+    mode: a failed TPU init silently falling back to CPU) opens its own
+    ledger instead of clobbering the accumulated TPU progress — each
+    experiment resumes independently."""
+    import hashlib
+    tag = hashlib.sha1(
+        json.dumps(meta, sort_keys=True).encode()).hexdigest()[:10]
+    return f"{LEDGER_BASE}_{meta['backend']}_{tag}.pkl"
 
 
 def main():
@@ -54,15 +66,39 @@ def main():
     # each run's knob values are recorded in its jsonl line instead.
     meta = {"n_tests": bench.N_TESTS, "n_trees": bench.N_TREES,
             "backend": jax.default_backend()}
+    LEDGER = ledger_path(meta)
     saved_scores = {}
     if os.path.exists(LEDGER):
         with open(LEDGER, "rb") as fd:
             saved = pickle.load(fd)
+        # The filename already encodes the meta; the embedded copy is a
+        # second check against hand-renamed files.
         if saved.get("meta") == meta:
             saved_scores = saved["scores"]
         else:
-            print(f"ledger meta mismatch (saved {saved.get('meta')} vs "
-                  f"{meta}) — starting fresh", file=sys.stderr)
+            raise SystemExit(
+                f"ledger {LEDGER} holds meta {saved.get('meta')} != {meta}; "
+                "refusing to run (delete or move the file to restart)")
+    # Legacy single-file ledger (pre per-meta naming): adopt its scores
+    # only when its meta matches; never delete or overwrite it.
+    legacy = LEDGER_BASE + ".pkl"
+    if not saved_scores and os.path.exists(legacy):
+        with open(legacy, "rb") as fd:
+            saved = pickle.load(fd)
+        if saved.get("meta") == meta:
+            saved_scores = saved["scores"]
+    # The per-meta scheme absorbs a backend flip silently (that is its
+    # point: no clobbering) — but a silent TPU->CPU jax fallback is the
+    # documented failure mode, so say out loud when ledgers for OTHER
+    # experiments exist alongside this one.
+    import glob
+    others = [p for p in glob.glob(LEDGER_BASE + "*.pkl")
+              if p not in (LEDGER, legacy)]
+    if others:
+        print(f"note: backend={meta['backend']} using {LEDGER}; other "
+              f"experiment ledgers present: {sorted(others)} — if you "
+              "expected to resume one of those, this run's meta "
+              f"({meta}) differs", file=sys.stderr)
     # run_grid only needs the subset covering this (possibly
     # F16_GRID_CONFIGS-limited) grid; the checkpoint below always merges
     # into the FULL saved dict so a limited smoke run can never destroy
