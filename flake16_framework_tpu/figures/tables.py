@@ -122,7 +122,11 @@ def top_config_tables(scores):
     4 buckets by (flaky type, feature set); NOD/OD tables pair FlakeFlagger
     and Flake16 rows side by side."""
     buckets = [[] for _ in range(4)]
-    for config_keys, (t_train, t_test, _, total) in scores.items():
+    for config_keys, v in scores.items():
+        # v[:4] — mesh-produced entries carry a 5th "timing:batch-amortized"
+        # marker (sweep.SweepEngine.TIMING_AMORTIZED) past the reference
+        # schema; indexes 0-3 are schema-stable either way.
+        t_train, t_test, _, total = v[:4]
         flaky_type, feature_set, *rest = config_keys
         f = total[-1]
         i = 2 * (flaky_type == "OD") + (feature_set == "Flake16")
@@ -146,8 +150,10 @@ def top_config_tables(scores):
 def comparison_table(scores_a, scores_b):
     """Per-project side-by-side of two configs, rows where both have complete
     P/R/F (reference get_comparison_table experiment.py:577-586)."""
-    per_a, total_a = scores_a[2:]
-    per_b, total_b = scores_b[2:]
+    # [2:4], not [2:]: mesh-batched entries carry a trailing timing marker
+    # past the reference schema (see top_config_tables).
+    per_a, total_a = scores_a[2:4]
+    per_b, total_b = scores_b[2:4]
     rows = [
         [proj, *row_a, *per_b[proj]]
         for proj, row_a in per_a.items()

@@ -538,12 +538,19 @@ class SweepEngine:
             )
         return self._sharded_fns[key]
 
+    # Appended as a 5th element to every run_config_batch result so a
+    # reader of the pickle ALONE can tell amortized clocks from the
+    # per-process ones (indexes 0-3 keep the reference schema; the
+    # reference's own readers never index past 3).
+    TIMING_AMORTIZED = "timing:batch-amortized"
+
     def run_config_batch(self, config_batch):
         """Run a batch of same-family configs over the mesh's config axis.
-        Returns a list of per-config results in the run_config schema; batch
-        wall-clock is attributed evenly (per-config times on a shared SPMD
-        step are not separable — documented deviation from the reference's
-        per-process clocks)."""
+        Returns a list of per-config results in the run_config schema plus
+        a trailing ``TIMING_AMORTIZED`` marker: batch wall-clock is
+        attributed evenly (per-config times on a shared SPMD step are not
+        separable — documented deviation from the reference's per-process
+        clocks, stamped into the artifact itself)."""
         fs_name, model_name = config_batch[0][1], config_batch[0][4]
         assert all(k[1] == fs_name and k[4] == model_name
                    for k in config_batch)
@@ -605,7 +612,7 @@ class SweepEngine:
                 counts[i], self.project_names, self.projects
             )
             out.append([t_train / self.n_folds, t_test / self.n_folds,
-                        scores, scores_total])
+                        scores, scores_total, self.TIMING_AMORTIZED])
         return out
 
     def run_grid(self, config_list=None, ledger=None, progress=None,

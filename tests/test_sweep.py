@@ -104,6 +104,11 @@ def test_sharded_engine_matches_per_config_path(engine):
         assert {k: v[:3] for k, v in sharded[keys][2].items()} == {
             k: v[:3] for k, v in res[2].items()
         }
+        # Mesh-batched entries self-describe their amortized clocks with a
+        # trailing marker; the per-config path keeps the bare 4-element
+        # reference schema (true per-config times).
+        assert sharded[keys][4] == sweep.SweepEngine.TIMING_AMORTIZED
+        assert len(res) == 4
 
 
 def test_lopo_cv_runs_and_holds_out_projects(engine):
