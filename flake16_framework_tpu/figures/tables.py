@@ -123,9 +123,8 @@ def top_config_tables(scores):
     and Flake16 rows side by side."""
     buckets = [[] for _ in range(4)]
     for config_keys, v in scores.items():
-        # v[:4] — mesh-produced entries carry a 5th "timing:batch-amortized"
-        # marker (sweep.SweepEngine.TIMING_AMORTIZED) past the reference
-        # schema; indexes 0-3 are schema-stable either way.
+        # v[:4]: tolerate wider-than-reference entries (defensive only —
+        # our writers emit the exact 4-element schema).
         t_train, t_test, _, total = v[:4]
         flaky_type, feature_set, *rest = config_keys
         f = total[-1]
@@ -150,8 +149,8 @@ def top_config_tables(scores):
 def comparison_table(scores_a, scores_b):
     """Per-project side-by-side of two configs, rows where both have complete
     P/R/F (reference get_comparison_table experiment.py:577-586)."""
-    # [2:4], not [2:]: mesh-batched entries carry a trailing timing marker
-    # past the reference schema (see top_config_tables).
+    # [2:4], not [2:]: tolerate wider-than-reference entries (defensive
+    # only — our writers emit the exact 4-element schema).
     per_a, total_a = scores_a[2:4]
     per_b, total_b = scores_b[2:4]
     rows = [

@@ -401,6 +401,10 @@ class SweepEngine:
         self.dispatch_folds = dispatch_folds
         # tests shrink ensembles: {"Random Forest": 10, ...}
         self.tree_overrides = tree_overrides or {}
+        # Configs whose T_TRAIN/T_TEST are batch-amortized (every config
+        # that went through run_config_batch on this engine) — the timing
+        # provenance write_scores persists beside the pickle.
+        self.amortized_configs = set()
         self._fns = {}
         self._sharded_fns = {}
         # Fold masks depend on the label vector => per flaky type
@@ -538,19 +542,18 @@ class SweepEngine:
             )
         return self._sharded_fns[key]
 
-    # Appended as a 5th element to every run_config_batch result so a
-    # reader of the pickle ALONE can tell amortized clocks from the
-    # per-process ones (indexes 0-3 keep the reference schema; the
-    # reference's own readers never index past 3).
-    TIMING_AMORTIZED = "timing:batch-amortized"
-
     def run_config_batch(self, config_batch):
         """Run a batch of same-family configs over the mesh's config axis.
-        Returns a list of per-config results in the run_config schema plus
-        a trailing ``TIMING_AMORTIZED`` marker: batch wall-clock is
-        attributed evenly (per-config times on a shared SPMD step are not
-        separable — documented deviation from the reference's per-process
-        clocks, stamped into the artifact itself)."""
+        Returns a list of per-config results in the run_config schema;
+        batch wall-clock is attributed evenly (per-config times on a shared
+        SPMD step are not separable — a documented deviation from the
+        reference's per-process clocks). The values keep the EXACT
+        4-element reference schema — the reference's own readers unpack
+        strictly (experiment.py:564 ``t_train, t_test, _, (*_, f) = ...``,
+        :578 ``[2:]`` into two names), so an in-value marker would break
+        the artifact-interchange contract (constants.py). Which configs
+        carry amortized clocks is recorded in ``self.amortized_configs``
+        instead, and persisted as a sidecar by pipeline.write_scores."""
         fs_name, model_name = config_batch[0][1], config_batch[0][4]
         assert all(k[1] == fs_name and k[4] == model_name
                    for k in config_batch)
@@ -612,7 +615,8 @@ class SweepEngine:
                 counts[i], self.project_names, self.projects
             )
             out.append([t_train / self.n_folds, t_test / self.n_folds,
-                        scores, scores_total, self.TIMING_AMORTIZED])
+                        scores, scores_total])
+        self.amortized_configs.update(tuple(k) for k in config_batch)
         return out
 
     def run_grid(self, config_list=None, ledger=None, progress=None,

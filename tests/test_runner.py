@@ -213,3 +213,36 @@ def test_provision_without_pins_falls_back_unpinned(tmp_path, monkeypatch):
     assert any("psutil" in j for j in joined)
     assert any(j.startswith("pip install") and "--no-deps" not in j
                and "-e" in j for j in joined)
+
+
+def test_provision_dryrun_transcript_is_complete():
+    # tools/provision_dryrun renders provision_subject's captured command
+    # sequence as the runnable L1 script (the demonstrated end-to-end path
+    # this Docker-less/egress-less environment can record — COMPONENTS.md
+    # row 3). The transcript must carry every provisioning stage in order,
+    # at image paths, seeded from the vendored study freeze.
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "provision_dryrun",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "provision_dryrun.py"),
+    )
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+
+    subj = next(s for s in iter_subjects() if s.name == "loguru")
+    script = m.provision_script(subj)
+    lines = [ln for ln in script.splitlines() if ln and not ln.startswith("#")]
+    stages = ["cp ", "virtualenv ", "git clone https://github.com/Delgan/loguru",
+              "git reset --hard " + subj.sha, "pip install -I --no-deps pip==",
+              "-r /home/user/subjects/loguru/requirements.txt", "-e "]
+    pos = -1
+    for stage in stages:
+        nxt = next((i for i, ln in enumerate(lines) if stage in ln), None)
+        assert nxt is not None, (stage, lines)
+        assert nxt > pos or stage == stages[0], (stage, lines)
+        pos = max(pos, nxt)
+    # venv-relative PATH rides every pip step; no temp-dir path leaks out
+    assert all("/venv/bin" in ln for ln in lines if "pip install" in ln)
+    assert "/tmp" not in script
