@@ -1,0 +1,24 @@
+"""RF parity probe config through the EXACT grower tier (1+ seeds)."""
+import json, os, sys, time
+sys.path.insert(0, '/root/repo')
+import numpy as np
+import parity
+from flake16_framework_tpu.utils.synth import make_dataset
+
+feats, labels, pids = make_dataset(n_tests=4000, seed=7, nod_bump=2.5,
+                                   od_bump=1.8, noise_sigma=0.35)
+cache = json.load(open('/root/repo/parity_sklearn_n4000_t100.json'))
+keys = ("NOD", "Flake16", "Scaling", "SMOTE", "Random Forest")
+sk = np.array(cache['f1s']['/'.join(keys)][:6])
+seeds = [int(s) for s in sys.argv[1:]] or [0]
+for s in seeds:
+    t0 = time.time()
+    f1 = parity.ours_config_f1s(feats, labels, pids, keys, n_trees=100,
+                                seeds=[s], grower="exact")[0]
+    rec = {"arm": "rf_exact_tier", "seed": s, "f1": round(float(f1), 4),
+           "sklearn_mean": round(float(sk.mean()), 4),
+           "delta_1seed": round(float(f1 - sk.mean()), 4),
+           "wall_s": round(time.time() - t0, 1)}
+    print(json.dumps(rec), flush=True)
+    with open('/root/repo/_scratch/parity_diag.jsonl', 'a') as fd:
+        fd.write(json.dumps(rec) + '\n')

@@ -1,0 +1,34 @@
+"""RF PARITY PROBE config (Scaling/SMOTE) at higher bin counts.
+
+The round-4 single-tree ablation (diag_tree_arms.py) showed the identical-
+weights single-tree gap is bins-driven: -0.0204 at 64 bins, noise-level at
+256+, exact grower -0.0009. The round-3 ensemble bins sweep that read flat
+(+0.07) ran on the no-SMOTE DIAGNOSTIC config; the criterion config was
+only ever tried at 128. This measures the criterion config itself.
+"""
+import json, os, sys, time
+sys.path.insert(0, '/root/repo')
+import numpy as np
+import parity
+from flake16_framework_tpu.utils.synth import make_dataset
+
+feats, labels, pids = make_dataset(n_tests=4000, seed=7, nod_bump=2.5,
+                                   od_bump=1.8, noise_sigma=0.35)
+cache = json.load(open('/root/repo/parity_sklearn_n4000_t100.json'))
+keys = ("NOD", "Flake16", "Scaling", "SMOTE", "Random Forest")
+sk = np.array(cache['f1s']['/'.join(keys)][:6])
+seeds = range(int(os.environ.get("DIAG_SEEDS", "6")))
+out = {"config": "/".join(keys),
+       "bins": os.environ.get("F16_HIST_BINS", "64"),
+       "k": len(list(seeds)), "sklearn_mean": round(float(sk.mean()), 4)}
+t0 = time.time()
+ours = np.array(parity.ours_config_f1s(feats, labels, pids, keys,
+                                       n_trees=100, seeds=seeds))
+out.update(ours_mean=round(float(ours.mean()), 4),
+           ours_sd=round(float(ours.std()), 4),
+           delta=round(float(ours.mean() - sk.mean()), 4),
+           se=round(float(ours.std() / max(len(ours) - 1, 1) ** 0.5), 4),
+           wall_s=round(time.time() - t0, 1))
+print(json.dumps(out), flush=True)
+with open('/root/repo/_scratch/parity_diag.jsonl', 'a') as fd:
+    fd.write(json.dumps(out) + '\n')

@@ -1,0 +1,40 @@
+"""A/B of FEATURE_QUOTA on the cleanest RF signal (Scaling/None, no SMOTE).
+
+Round-3 recorded ours=0.5833 (+0.0703 vs sklearn 0.513) on this config
+with the "informative" quota. If sklearn-quota semantics are the
+mechanism, this delta should collapse toward 0.
+"""
+import json, os, sys, time
+sys.path.insert(0, '/root/repo')
+import numpy as np
+import parity
+from flake16_framework_tpu.utils.synth import make_dataset
+from flake16_framework_tpu.ops import trees
+
+feats, labels, pids = make_dataset(n_tests=4000, seed=7, nod_bump=2.5,
+                                   od_bump=1.8, noise_sigma=0.35)
+keys = tuple(os.environ.get(
+    "DIAG_CONFIG", "NOD/Flake16/Scaling/None/Random Forest").split("/"))
+SK = {"NOD/Flake16/Scaling/None/Random Forest": (0.513, 0.0056)}
+cache_path = '/root/repo/parity_sklearn_n4000_t100.json'
+ck = "/".join(keys)
+if os.path.exists(cache_path):
+    cache = json.load(open(cache_path))
+    if ck in cache.get('f1s', {}):
+        arr = np.array(cache['f1s'][ck][:6])
+        SK[ck] = (float(arr.mean()), float(arr.std()))
+sk_mean, sk_sd = SK[ck]
+seeds = range(int(os.environ.get("DIAG_SEEDS", "6")))
+t0 = time.time()
+ours = np.array(parity.ours_config_f1s(feats, labels, pids, keys,
+                                       n_trees=100, seeds=seeds))
+out = {"config": ck, "quota": trees.FEATURE_QUOTA,
+       "bins": os.environ.get("F16_HIST_BINS", "64"),
+       "k": len(ours), "sklearn_mean": round(sk_mean, 4),
+       "ours_mean": round(float(ours.mean()), 4),
+       "ours_sd": round(float(ours.std()), 4),
+       "delta": round(float(ours.mean() - sk_mean), 4),
+       "wall_s": round(time.time() - t0, 1)}
+print(json.dumps(out), flush=True)
+with open('/root/repo/_scratch/parity_diag.jsonl', 'a') as fd:
+    fd.write(json.dumps(out) + '\n')

@@ -1,0 +1,64 @@
+"""Single trees on IDENTICAL bootstrap weights: which arm closes the gap?
+
+Round-3 found single trees at -0.020 vs sklearn on pinned weights (unit
+weights match at +0.003), but the bins sweep that 'exonerated threshold
+resolution' measured the ENSEMBLE delta. This re-runs the single-tree
+observable across growers/bins: if the exact grower reads ~0 while hist
+stays low at any bin count, the deviation is hist-structural; if exact is
+also low, the mechanism is shared (feature sampling / stopping).
+"""
+import json, sys
+sys.path.insert(0, '/root/repo')
+import numpy as np, jax
+from sklearn.tree import DecisionTreeClassifier
+from sklearn.metrics import f1_score
+from flake16_framework_tpu.utils.synth import make_dataset
+from flake16_framework_tpu.ops import trees
+from flake16_framework_tpu.config import FLAKY_TYPES
+
+feats, labels, pids = make_dataset(n_tests=4000, seed=7, nod_bump=2.5,
+                                   od_bump=1.8, noise_sigma=0.35)
+y = (labels == FLAKY_TYPES["NOD"]).astype(int)
+x = feats.astype(np.float32)
+mu, sd = x.mean(0), x.std(0); sd[sd == 0] = 1
+x = (x - mu) / sd
+rng = np.random.RandomState(0)
+idx = rng.permutation(len(y)); tr, te = idx[:3000], idx[3000:]
+xtr, ytr = x[tr], y[tr]
+
+SEEDS = 10
+ws = [np.bincount(np.random.RandomState(100 + s).randint(0, 3000, 3000),
+                  minlength=3000).astype(np.float32) for s in range(SEEDS)]
+
+sk = []
+for s in range(SEEDS):
+    m = DecisionTreeClassifier(max_features="sqrt", random_state=s
+                               ).fit(xtr, ytr, sample_weight=ws[s])
+    sk.append(f1_score(y[te], m.predict(x[te])))
+print(json.dumps({"arm": "sklearn", "mean": round(float(np.mean(sk)), 4),
+                  "sd": round(float(np.std(sk)), 4)}), flush=True)
+
+
+def run_arm(tag, fit):
+    f1s = []
+    for s in range(SEEDS):
+        f = fit(s)
+        p = np.asarray(trees.predict_proba(f, x[te]))
+        f1s.append(f1_score(y[te], p[:, 1] > 0.5))
+    print(json.dumps({
+        "arm": tag, "mean": round(float(np.mean(f1s)), 4),
+        "sd": round(float(np.std(f1s)), 4),
+        "delta_vs_sk": round(float(np.mean(f1s) - np.mean(sk)), 4)},
+    ), flush=True)
+
+
+for nb in (64, 256, 1024):
+    run_arm(f"hist_b{nb}", lambda s, nb=nb: trees.fit_forest_hist(
+        xtr, ytr.astype(bool), ws[s], jax.random.PRNGKey(s),
+        n_trees=1, bootstrap=False, random_splits=False,
+        sqrt_features=True, max_depth=48, max_nodes=4 * 3000, n_bins=nb))
+
+run_arm("exact", lambda s: trees.fit_forest(
+    xtr, ytr.astype(bool), ws[s], jax.random.PRNGKey(s),
+    n_trees=1, bootstrap=False, random_splits=False,
+    sqrt_features=True, max_depth=48, max_nodes=4 * 3000))

@@ -127,16 +127,37 @@ def _proxy_score(lw, lwy, rw, rwy, valid):
     return jnp.where(valid, score, -jnp.inf)
 
 
+# Per-node feature-quota semantics (both growers; model-changing A/B knob
+# like F16_ET_DRAW, read at import):
+# - "informative" (default; round-2/3 behavior): select max_features
+#   NON-constant features — constants never consume the quota.
+# - "sklearn": constant-feature visits CONSUME the max_features quota,
+#   replicating sklearn 1.0.2 _splitter.pyx node_split exactly
+#   (n_visited_features counts drawn-known-constant and found-constant
+#   features alike; the visit loop extends past the quota only until the
+#   first non-constant). Round-4 parity isolation RULED THIS OUT as the RF
+#   ensemble deviation mechanism: the no-SMOTE diagnostic config reads
+#   +0.0721 under this arm vs +0.0703 under "informative" (6 seeds, 64
+#   bins) — no movement — so the default stays the arm the ET parity
+#   record was validated under.
+FEATURE_QUOTA = os.environ.get("F16_FEATURE_QUOTA", "informative")
+if FEATURE_QUOTA not in ("sklearn", "informative"):
+    raise ValueError(
+        f"F16_FEATURE_QUOTA must be sklearn|informative, got {FEATURE_QUOTA!r}"
+    )
+
+
 def _select_features(nc, key, max_features):
-    """sklearn splitter feature sampling: draw features in uniform-random order,
-    skip constants, stop after ``max_features`` non-constant ones.
+    """sklearn splitter feature sampling: visit features in uniform-random
+    order and return the non-constant ones in the visited prefix (see
+    FEATURE_QUOTA above for what bounds the prefix).
 
     nc: [W, F] bool — feature non-constant within node.
     ``key`` is either one uint32 key [2] (one draw covering all rows) or
     per-row keys [W, 2]; the hist grower passes per-node keys derived from
     global node ids so the node-batch width stays results-neutral.
-    Returns sel [W, F] bool. With fewer than max_features non-constant
-    features, all of them are selected (sklearn exhausts the draw).
+    Returns sel [W, F] bool; empty rows (no informative feature) leaf out
+    in the caller via the -inf score path.
     """
     if max_features is None:
         return nc
@@ -144,9 +165,19 @@ def _select_features(nc, key, max_features):
         u = jax.vmap(lambda k: jax.random.uniform(k, nc.shape[1:]))(key)
     else:
         u = jax.random.uniform(key, nc.shape)
-    r = jnp.where(nc, u, jnp.inf)
-    kth = jnp.sort(r, axis=1)[:, max_features - 1 : max_features]
-    return (r <= kth) & nc
+    if FEATURE_QUOTA == "informative":
+        r = jnp.where(nc, u, jnp.inf)
+        kth = jnp.sort(r, axis=1)[:, max_features - 1 : max_features]
+        return (r <= kth) & nc
+    # "sklearn": visit order = rank of u; the visited prefix is
+    # max_features long, extended to reach the first non-constant when the
+    # quota's worth of visits were all constants. Selected = non-constant
+    # in prefix. All-constant rows select nothing (the caller leafs).
+    f = nc.shape[1]
+    rank = jnp.argsort(jnp.argsort(u, axis=1), axis=1)
+    minrank_nc = jnp.min(jnp.where(nc, rank, f), axis=1, keepdims=True)
+    prefix = jnp.maximum(max_features, minrank_nc + 1)
+    return nc & (rank < prefix)
 
 
 def _run_boundaries(s_rel):

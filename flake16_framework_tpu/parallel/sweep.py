@@ -20,6 +20,7 @@ Fit and predict run as two jitted stages so the reference's per-config
 T_TRAIN/T_TEST timing fields (experiment.py:468-474) stay measurable.
 """
 
+import os
 import time
 
 import jax
@@ -54,7 +55,7 @@ def _auto_tree_chunk(spec, n_folds, tree_chunk, use_hist):
 
 
 def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
-                     n_folds=N_FOLDS, tree_chunk=None):
+                     n_folds=N_FOLDS, tree_chunk=None, grower=None):
     """The per-config CV pipeline, unjitted: (fit_one, score_one).
 
     fit_one(x, y_raw, flaky_label, prep_code, bal_code, key, train_mask)
@@ -68,10 +69,22 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
     if cap is None:
         cap = 2 * n  # SMOTE at worst doubles the training set
     max_nodes = 2 * cap
-    # Ensembles fit via the MXU histogram grower (trees.py: binned splits
-    # wash out in the 100-tree average); the single DecisionTree keeps the
-    # exact sort-based grower for sklearn-exact splits.
-    use_hist = spec.n_trees > 1
+    # Ensemble grower tier (decided at trace time, like the backend splits):
+    # - "hist" (default): the MXU histogram grower — the performance tier.
+    #   Binned splits act as a mild regularizer whose ensemble F1 reads
+    #   UNIFORMLY ABOVE sklearn's exact-split forests on the study data
+    #   (round-3/4 parity isolation: +0.07 no-SMOTE diagnostic, +0.018
+    #   probe config; bins-, quota-, and bootstrap-insensitive — an
+    #   architecture property, not a bug).
+    # - "exact": sklearn-semantics sort-based splits for ensembles too —
+    #   the parity tier (BASELINE.md ±0.01 is judged against this tier for
+    #   RF; DT always uses it). Slower: gather-bound, kept off the bench
+    #   path. ``grower`` overrides; F16_ENSEMBLE_GROWER is the env default.
+    g = grower or os.environ.get("F16_ENSEMBLE_GROWER", "hist")
+    if g not in ("hist", "exact"):
+        raise ValueError(
+            f"grower/F16_ENSEMBLE_GROWER must be hist|exact, got {g!r}")
+    use_hist = spec.n_trees > 1 and g == "hist"
     tree_chunk = _auto_tree_chunk(spec, n_folds, tree_chunk, use_hist)
 
     def _prep(x, y_raw, flaky_label, prep_code):
@@ -161,7 +174,7 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
 
 
 def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
-                n_folds=N_FOLDS, tree_chunk=None):
+                n_folds=N_FOLDS, tree_chunk=None, grower=None):
     """Build (cv_fit, cv_score) jitted for one model family.
 
     All config axes inside a family are traced ints; shapes depend only on
@@ -174,13 +187,13 @@ def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
     """
     fns = _make_config_fns(
         spec, n=n, n_projects=n_projects, cap=cap, max_depth=max_depth,
-        n_folds=n_folds, tree_chunk=tree_chunk,
+        n_folds=n_folds, tree_chunk=tree_chunk, grower=grower,
     )
     return tuple(jax.jit(f) for f in fns)
 
 
 def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
-                        n_folds=N_FOLDS, tree_chunk=None):
+                        n_folds=N_FOLDS, tree_chunk=None, grower=None):
     """Two-stage config-batched CV over the mesh's "config" axis — the
     production sweep path (the reference forks a process per config,
     experiment.py:493-498; here a batch of configs is one SPMD program).
@@ -203,7 +216,7 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
     (fit_one, score_one, prep_resample_one, fit_trees_chunk,
      tree_keys_one) = _make_config_fns(
         spec, n=n, n_projects=n_projects, max_depth=max_depth,
-        n_folds=n_folds, tree_chunk=tree_chunk,
+        n_folds=n_folds, tree_chunk=tree_chunk, grower=grower,
     )
 
     def fit_batch(x, y_raw, fls, preps, bals, keys, train_masks):
@@ -378,7 +391,7 @@ class SweepEngine:
     def __init__(self, features, labels_raw, projects, project_names,
                  project_ids, *, mesh=None, max_depth=48, seed=0,
                  n_folds=None, tree_overrides=None, cv="stratified",
-                 dispatch_trees=None, dispatch_folds=None):
+                 dispatch_trees=None, dispatch_folds=None, grower=None):
         self.features = np.asarray(features, dtype=np.float32)
         self.labels_raw = np.asarray(labels_raw, dtype=np.int32)
         self.projects = projects
@@ -388,6 +401,9 @@ class SweepEngine:
         self.max_depth = max_depth
         self.seed = seed
         self.cv = cv
+        # Ensemble grower tier (None = env default "hist"); "exact" is the
+        # parity tier — see _make_config_fns.
+        self.grower = grower
         # Upper bounds on work per device dispatch in run_config
         # (bit-identical results; single-dispatch duration control — the
         # TPU tunnel faults on multi-minute dispatches, PROFILE.md):
@@ -450,6 +466,7 @@ class SweepEngine:
                     self._spec(model_name), n=n, n_feat=len(cols),
                     n_projects=len(self.project_names),
                     max_depth=self.max_depth, n_folds=self.n_folds,
+                    grower=self.grower,
                 ),
                 cols,
             )
@@ -537,6 +554,7 @@ class SweepEngine:
                     self._spec(model_name), self.mesh, n=n, n_feat=len(cols),
                     n_projects=len(self.project_names),
                     max_depth=self.max_depth, n_folds=self.n_folds,
+                    grower=self.grower,
                 ),
                 cols,
             )

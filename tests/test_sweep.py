@@ -249,6 +249,26 @@ def test_fold_chunked_fit_matches_single_dispatch(engine):
         assert a[2] == b[2], keys
 
 
+def test_exact_grower_tier_runs_and_validates(engine):
+    # The parity tier (grower="exact") routes ensembles through the exact
+    # sort-based grower (sklearn-semantics splits — parity.py's RF
+    # criterion row). Same schema, different model: counts must be
+    # populated and the tier choice must be validated loudly.
+    ex = _make_engine(grower="exact")
+    keys = ("NOD", "Flake16", "None", "None", "Random Forest")
+    res = ex.run_config(keys)
+    assert sum(res[3][:3]) > 0
+    assert len(res) == 4
+    # dispatch-chunking composes with the exact tier (parity --full runs
+    # chunked on the TPU tunnel): bit-identical to the unchunked fit.
+    ex_chunked = _make_engine(grower="exact", dispatch_trees=3)
+    assert ex_chunked.run_config(keys)[3] == res[3]
+
+    bad = _make_engine(grower="binned")
+    with pytest.raises(ValueError, match="hist|exact"):
+        bad.run_config(keys)
+
+
 def test_chunked_fit_retries_transient_unavailable(monkeypatch):
     # A chunk dispatch that faults with the tunnel's UNAVAILABLE signature
     # is retried once (chunks are deterministic); other errors propagate.
