@@ -268,12 +268,32 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
         # run whatever tier measured them.
         entry["grower"] = "exact" if keys[4] == "Decision Tree" else "hist"
         if keys[4] in exact_tier_models and keys[4] != "Decision Tree":
-            ox = np.array(ours_config_f1s(
-                feats, labels, pids, keys, n_trees=n_trees,
-                seeds=range(k_exact or k_ours), grower="exact",
-            ))
+            kx = k_exact or k_ours
+            # PARITY_OURS_EXACT_CACHE: precomputed exact-tier per-seed F1s
+            # ({"f1s": {"A/B/C/D/E": [...]}, params...}) — the exact
+            # grower costs ~1.5 h/seed on one CPU core, so wall-limited
+            # runs reuse seeds measured out-of-band (provenance recorded).
+            ox, src = None, "computed"
+            xc_path = os.environ.get("PARITY_OURS_EXACT_CACHE")
+            if xc_path:
+                with open(xc_path) as fd:
+                    xc = json.load(fd)
+                for name in ("n_tests", "n_trees"):
+                    assert xc[name] == {"n_tests": n_tests,
+                                        "n_trees": n_trees}[name], name
+                got = xc["f1s"].get("/".join(keys), [])
+                if len(got) >= 2:
+                    ox = np.array(got[:kx])
+                    src = f"cache:{os.path.basename(xc_path)}" + (
+                        f" ({xc['precision']})" if "precision" in xc else "")
+            if ox is None:
+                ox = np.array(ours_config_f1s(
+                    feats, labels, pids, keys, n_trees=n_trees,
+                    seeds=range(kx), grower="exact",
+                ))
             exact_entry = side(ox)
             exact_entry["grower"] = "exact"
+            exact_entry["ours_source"] = src
             # criterion row = exact tier; production tier published beside
             entry = dict(exact_entry, default_tier=entry)
         report["/".join(keys)] = entry
@@ -320,7 +340,11 @@ def main():
             # RF's criterion row runs the exact (sklearn-semantics) grower
             # tier; the hist tier's uniformly-upward deviation is recorded
             # in its default_tier sub-dict (see run_parity docstring).
+            # PARITY_K_EXACT bounds the exact-tier seed count — the exact
+            # grower costs ~40+ min/seed on one CPU core at full size, so
+            # wall-limited runs can trade seeds for completion.
             exact_tier_models=("Random Forest",),
+            k_exact=int(os.environ.get("PARITY_K_EXACT", "6")),
         )
         import jax
 

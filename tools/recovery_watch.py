@@ -248,8 +248,11 @@ def chain():
     persist_bench_json(out, "bench_tpu.json")
     if not ok_b and not listener_up():
         return False
+    # 10800 s: the round-4 exact-grower RF criterion tier adds several
+    # exact 100-tree x 10-fold fits (minutes each on the TPU, ~45 min each
+    # on a CPU fallback) on top of the ~70 min hist tiers.
     ok_p, _ = run_stage(
-        "parity_full", [py, os.path.join(REPO, "parity.py"), "--full"], 5400,
+        "parity_full", [py, os.path.join(REPO, "parity.py"), "--full"], 10800,
         env_extra={"PARITY_SKLEARN_CACHE": os.path.join(
             REPO, "parity_sklearn_n4000_t100.json")},
     )
