@@ -177,7 +177,8 @@ def ours_config_f1s(feats, labels, pids, keys, *, n_trees, seeds,
 
 def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
                nod_bump=2.5, od_bump=1.8, noise_sigma=0.35, configs=None,
-               sklearn_cache=None, exact_tier_models=(), k_exact=None):
+               sklearn_cache=None, exact_tier_models=(), k_exact=None,
+               ours_exact_cache=None):
     """Seed-averaged F1 comparison. Returns a report dict per config.
 
     ``sklearn_cache``: optional path to a JSON of precomputed sklearn-side
@@ -194,30 +195,35 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
     insensitive), so the ±0.01 criterion is judged where like is compared
     with like, and the production tier's (favorable) deviation is published
     beside it rather than hidden. ``k_exact`` bounds the exact-tier seed
-    count (default ``k_ours``)."""
+    count (default ``k_ours``); ``ours_exact_cache`` is the ours-side twin
+    of ``sklearn_cache`` (the exact grower costs ~1.5 h/seed on one CPU
+    core, so wall-limited runs reuse seeds measured out-of-band — source
+    and precision provenance recorded in the criterion row)."""
     from flake16_framework_tpu.utils.synth import make_dataset
 
-    cache = None
-    if sklearn_cache:
-        # a typo'd path must not silently fall back to the ~1 h recompute
-        with open(sklearn_cache) as fd:
-            cache = json.load(fd)
-        params = dict(n_tests=n_tests, n_trees=n_trees, data_seed=data_seed,
-                      nod_bump=nod_bump, od_bump=od_bump,
-                      noise_sigma=noise_sigma)
-        # Every dataset parameter is recorded in the cache at generation
-        # time (``--gen-cache``), so compatibility is cache-vs-run with no
-        # defaults fallback: a fallback to either the historical or the
-        # current signature defaults can silently validate a stale cache
-        # when a default changes between generation and use.
+    params = dict(n_tests=n_tests, n_trees=n_trees, data_seed=data_seed,
+                  nod_bump=nod_bump, od_bump=od_bump,
+                  noise_sigma=noise_sigma)
+
+    def load_cache(path, what):
+        # a typo'd path must not silently fall back to a recompute, and
+        # EVERY dataset parameter is validated (recorded at generation
+        # time) — a cache from a different dataset must never validate.
+        with open(path) as fd:
+            c = json.load(fd)
         for name, val in params.items():
-            assert name in cache, (
-                f"sklearn cache lacks {name!r} — regenerate it (old caches "
+            assert name in c, (
+                f"{what} cache lacks {name!r} — regenerate it (old caches "
                 "without recorded dataset params are not trusted)"
             )
-            assert cache[name] == val, (
-                f"sklearn cache {name}={cache[name]} != this run's {val}"
+            assert c[name] == val, (
+                f"{what} cache {name}={c[name]} != this run's {val}"
             )
+        return c
+
+    cache = load_cache(sklearn_cache, "sklearn") if sklearn_cache else None
+    exact_cache = (load_cache(ours_exact_cache, "ours-exact")
+                   if ours_exact_cache else None)
     feats, labels, pids = make_dataset(
         n_tests=n_tests, seed=data_seed, nod_bump=nod_bump, od_bump=od_bump,
         noise_sigma=noise_sigma,
@@ -269,23 +275,19 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
         entry["grower"] = "exact" if keys[4] == "Decision Tree" else "hist"
         if keys[4] in exact_tier_models and keys[4] != "Decision Tree":
             kx = k_exact or k_ours
-            # PARITY_OURS_EXACT_CACHE: precomputed exact-tier per-seed F1s
-            # ({"f1s": {"A/B/C/D/E": [...]}, params...}) — the exact
-            # grower costs ~1.5 h/seed on one CPU core, so wall-limited
-            # runs reuse seeds measured out-of-band (provenance recorded).
             ox, src = None, "computed"
-            xc_path = os.environ.get("PARITY_OURS_EXACT_CACHE")
-            if xc_path:
-                with open(xc_path) as fd:
-                    xc = json.load(fd)
-                for name in ("n_tests", "n_trees"):
-                    assert xc[name] == {"n_tests": n_tests,
-                                        "n_trees": n_trees}[name], name
-                got = xc["f1s"].get("/".join(keys), [])
-                if len(got) >= 2:
-                    ox = np.array(got[:kx])
-                    src = f"cache:{os.path.basename(xc_path)}" + (
-                        f" ({xc['precision']})" if "precision" in xc else "")
+            if exact_cache is not None:
+                got = exact_cache["f1s"].get("/".join(keys), [])
+                # an under-seeded cache must fail loudly, not silently
+                # judge the ±0.01 assertion on fewer seeds than configured
+                assert len(got) >= kx, (
+                    f"ours-exact cache has {len(got)} seeds for {keys}, "
+                    f"need {kx} (lower PARITY_K_EXACT or extend the cache)"
+                )
+                ox = np.array(got[:kx])
+                src = "cache:" + os.path.basename(ours_exact_cache) + (
+                    f" ({exact_cache['precision']})"
+                    if "precision" in exact_cache else "")
             if ox is None:
                 ox = np.array(ours_config_f1s(
                     feats, labels, pids, keys, n_trees=n_trees,
@@ -345,6 +347,7 @@ def main():
             # wall-limited runs can trade seeds for completion.
             exact_tier_models=("Random Forest",),
             k_exact=int(os.environ.get("PARITY_K_EXACT", "6")),
+            ours_exact_cache=os.environ.get("PARITY_OURS_EXACT_CACHE"),
         )
         import jax
 
@@ -369,11 +372,18 @@ def main():
 def run_small_tier():
     """The CPU regression tier (shared by ``python parity.py`` and pytest):
     same machinery as --full, sized for CI, tolerance scaled to its own
-    measured noise (at this size sklearn's seed sd alone exceeds 0.01)."""
-    rep = run_parity(n_tests=800, n_trees=16, k_ours=2, k_sk=4)
+    measured noise (at this size sklearn's seed sd alone exceeds 0.01).
+    RF runs the exact criterion tier here too, so the --full criterion
+    path (exact-grower ensembles through the chunked sweep) is exercised
+    end-to-end on every CI run, not first on the TPU."""
+    rep = run_parity(n_tests=800, n_trees=16, k_ours=2, k_sk=4,
+                     exact_tier_models=("Random Forest",))
     for name, v in rep.items():
         tol = max(0.05, 3 * v["se_delta"])
         assert abs(v["delta"]) <= tol, (name, v)
+        if "default_tier" in v:
+            d = v["default_tier"]
+            assert abs(d["delta"]) <= max(0.05, 3 * d["se_delta"]), (name, d)
     return rep
 
 
