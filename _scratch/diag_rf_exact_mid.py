@@ -1,0 +1,37 @@
+"""Exact-tier RF vs sklearn at mid size (N=2000, t=100) — the at-scale
+confidence datum the full-size CPU run cannot afford (~2 h/seed there).
+
+Same config family as the criterion row (Scaling/SMOTE), same harness
+machinery; sklearn side computed fresh (no cache exists at this size).
+"""
+import json, sys, time
+sys.path.insert(0, '/root/repo')
+import numpy as np
+import parity
+from flake16_framework_tpu.utils.synth import make_dataset
+
+N, T, K_SK, K_X = 2000, 100, 6, 3
+feats, labels, pids = make_dataset(n_tests=N, seed=7, nod_bump=2.5,
+                                   od_bump=1.8, noise_sigma=0.35)
+keys = ("NOD", "Flake16", "Scaling", "SMOTE", "Random Forest")
+
+t0 = time.time()
+sk = np.array([parity.sklearn_config_f1(feats, labels, keys, n_trees=T,
+                                        seed=s) for s in range(K_SK)])
+print(json.dumps({"arm": "sklearn_mid", "mean": round(float(sk.mean()), 4),
+                  "sd": round(float(sk.std()), 4),
+                  "wall_s": round(time.time() - t0, 1)}), flush=True)
+
+for s in range(K_X):
+    t0 = time.time()
+    f1 = parity.ours_config_f1s(feats, labels, pids, keys, n_trees=T,
+                                seeds=[s], grower="exact")[0]
+    rec = {"arm": "rf_exact_mid", "n_tests": N, "seed": s,
+           "f1": round(float(f1), 4),
+           "sklearn_mean": round(float(sk.mean()), 4),
+           "sklearn_sd": round(float(sk.std()), 4),
+           "delta_1seed": round(float(f1 - sk.mean()), 4),
+           "wall_s": round(time.time() - t0, 1)}
+    print(json.dumps(rec), flush=True)
+    with open('/root/repo/_scratch/parity_diag.jsonl', 'a') as fd:
+        fd.write(json.dumps(rec) + '\n')
