@@ -159,6 +159,17 @@ def ours_config_f1s(feats, labels, pids, keys, *, n_trees, seeds,
 
     names = [f"project{p:02d}" for p in range(int(pids.max()) + 1)]
     projects = np.array([names[p] for p in pids])
+    dc, df = _dispatch_env()
+    if grower == "exact" and dc is not None:
+        # The exact grower is ~20x slower per tree than hist (gather-
+        # bound): the bench's 25-tree dispatch default, sized for hist,
+        # would put a multi-minute single dispatch on the TPU tunnel —
+        # past the ~170 s fault envelope (PROFILE.md). 6 trees x 10 folds
+        # per dispatch stays inside it at round-2 exact-grower rates.
+        # 0 disables the clamp (same convention as the BENCH_* knobs).
+        clamp = int(os.environ.get("PARITY_EXACT_DISPATCH", "6")) or None
+        if clamp:
+            dc = min(dc, clamp)
     engine = SweepEngine(
         feats, labels, projects, names, pids,
         tree_overrides={"Random Forest": n_trees, "Extra Trees": n_trees},
@@ -166,7 +177,7 @@ def ours_config_f1s(feats, labels, pids, keys, *, n_trees, seeds,
         # Bounded dispatches (same env knobs/defaults as bench.py): the
         # full tier runs 100-tree x 10-fold fits on the TPU tunnel, which
         # faults on multi-minute single dispatches (PROFILE.md).
-        **dict(zip(("dispatch_trees", "dispatch_folds"), _dispatch_env())),
+        dispatch_trees=dc, dispatch_folds=df,
     )
     out = []
     for s in seeds:

@@ -126,3 +126,60 @@ def test_pick_tuned_env_batch_arm(tmp_path, monkeypatch):
         {"step": "rf_full", "ok": True, "out": ["steady_s 5.0"]},
     ])
     assert "BENCH_BATCH" not in rw.pick_tuned_env(0)
+
+
+def test_exact_seed_cache_checkpoints_per_seed(tmp_path, monkeypatch):
+    # tools/exact_seed_cache.py accumulates exact-tier parity seeds with a
+    # cache checkpoint after EVERY seed (wedge resilience: a device fault
+    # mid-tier keeps completed seeds). Compute is stubbed; the contract
+    # under test is checkpointing, resume, provenance, and the schema
+    # parity.run_parity consumes.
+    import importlib.util
+    import json
+
+    spec = importlib.util.spec_from_file_location(
+        "exact_seed_cache",
+        os.path.join(REPO, "tools", "exact_seed_cache.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+
+    path = str(tmp_path / "cache.json")
+    monkeypatch.setenv("PARITY_EXACT_CACHE_PATH", path)
+
+    calls = []
+
+    def fake_f1s(feats, labels, pids, keys, *, n_trees, seeds, grower):
+        assert grower == "exact" and n_trees == 100
+        calls.append(list(seeds))
+        return [0.6 + 0.01 * seeds[0]]
+
+    monkeypatch.setattr(m.parity, "ours_config_f1s", fake_f1s)
+    monkeypatch.setattr(
+        m.parity, "PROBE_CONFIGS",
+        [("NOD", "Flake16", "Scaling", "SMOTE", "Random Forest")])
+    m.EXACT_CONFIGS[:] = m.parity.PROBE_CONFIGS
+
+    m.main(2)
+    cache = json.load(open(path))
+    ck = "NOD/Flake16/Scaling/SMOTE/Random Forest"
+    assert cache["f1s"][ck] == [0.6, 0.61]
+    assert calls == [[0], [1]]  # one bounded run per seed
+    assert len(cache["seed_provenance"][ck]) == 2
+    assert cache["precision"] in ("f32", "f64")
+    assert cache["n_tests"] == 4000 and cache["data_seed"] == 7
+
+    # resume: topping up to 3 only computes the missing seed
+    calls.clear()
+    m.main(3)
+    cache = json.load(open(path))
+    assert calls == [[2]]
+    assert cache["f1s"][ck] == [0.6, 0.61, 0.62]
+
+    # a cache from different params refuses to merge
+    cache["n_tests"] = 2000
+    json.dump(cache, open(path, "w"))
+    try:
+        m.main(3)
+        raise AssertionError("should have refused the mismatched cache")
+    except AssertionError as e:
+        assert "move it aside" in str(e)

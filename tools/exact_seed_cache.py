@@ -1,0 +1,98 @@
+"""Accumulate exact-tier parity seeds into a wedge-resilient cache.
+
+The RF parity criterion row runs the exact grower tier (parity.py,
+round 4), which costs minutes per 100-tree x 10-fold seed on the TPU and
+~1.5 h on a CPU core. A mid-run device wedge inside `parity.py --full`
+would lose every completed exact seed; this builder computes them ONE
+seed at a time and checkpoints the cache json after each, so the watcher
+chain can re-enter after a wedge and only pay for missing seeds.
+
+    python tools/exact_seed_cache.py        # top up to 6 seeds
+    python tools/exact_seed_cache.py 4      # top up to 4
+
+Cache: _scratch/ours_exact_cache.json (PARITY_EXACT_CACHE_PATH overrides)
+in the PARITY_OURS_EXACT_CACHE schema parity.run_parity consumes: every
+dataset parameter stamped, per-seed backend/precision provenance, atomic
+replace per seed.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import parity  # noqa: E402
+
+PARAMS = dict(n_tests=4000, n_trees=100, data_seed=7, nod_bump=2.5,
+              od_bump=1.8, noise_sigma=0.35)
+EXACT_CONFIGS = [k for k in parity.PROBE_CONFIGS if k[4] == "Random Forest"]
+
+
+def cache_path():
+    return os.environ.get(
+        "PARITY_EXACT_CACHE_PATH",
+        os.path.join(REPO, "_scratch", "ours_exact_cache.json"))
+
+
+def load_or_init(path):
+    if os.path.exists(path):
+        with open(path) as fd:
+            cache = json.load(fd)
+        for name, val in PARAMS.items():
+            assert cache.get(name) == val, (
+                f"existing cache {name}={cache.get(name)} != {val}; move it "
+                "aside to regenerate")
+        return cache
+    return {**PARAMS, "f1s": {}, "seed_provenance": {}}
+
+
+def main(k):
+    import jax
+
+    from flake16_framework_tpu.utils.synth import make_dataset
+
+    path = cache_path()
+    cache = load_or_init(path)
+    feats, labels, pids = make_dataset(
+        n_tests=PARAMS["n_tests"], seed=PARAMS["data_seed"],
+        nod_bump=PARAMS["nod_bump"], od_bump=PARAMS["od_bump"],
+        noise_sigma=PARAMS["noise_sigma"])
+    prov = {"backend": jax.default_backend(),
+            "precision": "f64" if jax.config.jax_enable_x64 else "f32"}
+
+    for keys in EXACT_CONFIGS:
+        ck = "/".join(keys)
+        done = cache["f1s"].setdefault(ck, [])
+        cache["seed_provenance"].setdefault(ck, [])
+        while len(done) < k:
+            s = len(done)
+            t0 = time.time()
+            f1 = parity.ours_config_f1s(
+                feats, labels, pids, keys, n_trees=PARAMS["n_trees"],
+                seeds=[s], grower="exact")[0]
+            done.append(round(float(f1), 6))
+            cache["seed_provenance"][ck].append(
+                dict(prov, seed=s, wall_s=round(time.time() - t0, 1)))
+            # uniform-precision caches advertise it (parity surfaces it in
+            # the criterion row's provenance string)
+            all_prov = [p for ps in cache["seed_provenance"].values()
+                        for p in ps]
+            if len({p["precision"] for p in all_prov}) == 1:
+                cache["precision"] = all_prov[0]["precision"]
+            else:
+                cache.pop("precision", None)
+            with open(path + ".tmp", "w") as fd:
+                json.dump(cache, fd, indent=1)
+            os.replace(path + ".tmp", path)
+            print(json.dumps({"config": ck, "seed": s, "f1": done[-1],
+                              "wall_s": cache["seed_provenance"][ck][-1][
+                                  "wall_s"],
+                              "have": len(done), "want": k}), flush=True)
+    print(json.dumps({"cache": path, "complete": True}))
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 6)

@@ -248,13 +248,34 @@ def chain():
     persist_bench_json(out, "bench_tpu.json")
     if not ok_b and not listener_up():
         return False
-    # 10800 s: the round-4 exact-grower RF criterion tier adds several
-    # exact 100-tree x 10-fold fits (minutes each on the TPU, ~45 min each
-    # on a CPU fallback) on top of the ~70 min hist tiers.
+    # Exact-tier seeds FIRST, one bounded run per seed with a per-seed
+    # cache checkpoint (tools/exact_seed_cache.py): a wedge mid-tier
+    # keeps every completed seed, and the next chain attempt only pays
+    # for the missing ones. 6 seeds x ~20 min/seed at round-2 TPU
+    # exact-grower rates + slack.
+    ok_x, _ = run_stage(
+        "exact_seeds",
+        [py, os.path.join(REPO, "tools", "exact_seed_cache.py"), "6"], 10800,
+    )
+    if not ok_x and not listener_up():
+        return False
+    # parity --full consumes the cache when complete (it asserts loudly on
+    # an under-seeded cache, sending the watcher back to polling — the
+    # cache persists either way); without it, parity would recompute the
+    # exact seeds inline and lose them all to a wedge.
+    parity_env = {"PARITY_SKLEARN_CACHE": os.path.join(
+        REPO, "parity_sklearn_n4000_t100.json")}
+    exact_cache = os.path.join(REPO, "_scratch", "ours_exact_cache.json")
+    if os.path.exists(exact_cache):
+        # Pass the cache whenever the FILE exists, not only when the stage
+        # was green: a partially-filled cache makes parity fail fast on
+        # its under-seeded assert (watcher re-polls, cache persists and
+        # tops up next attempt) instead of recomputing every exact seed
+        # inline where a wedge loses them all.
+        parity_env["PARITY_OURS_EXACT_CACHE"] = exact_cache
     ok_p, _ = run_stage(
         "parity_full", [py, os.path.join(REPO, "parity.py"), "--full"], 10800,
-        env_extra={"PARITY_SKLEARN_CACHE": os.path.join(
-            REPO, "parity_sklearn_n4000_t100.json")},
+        env_extra=parity_env,
     )
     if not ok_p and not listener_up():
         return False
