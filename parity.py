@@ -370,8 +370,12 @@ def main():
                # but the record must say which backend ran the ours side
                "ours_backend": jax.default_backend(),
                "ok": all(abs(v["delta"]) <= tol for v in rep.values())}
-        with open(os.path.join(REPO, "PARITY.json"), "w") as fd:
+        # Atomic replace: a kill mid-dump must never corrupt an existing
+        # green record.
+        path = os.path.join(REPO, "PARITY.json")
+        with open(path + ".tmp", "w") as fd:
             json.dump(out, fd, indent=2)
+        os.replace(path + ".tmp", path)
         print(json.dumps({"parity_ok": out["ok"], "tolerance": tol}))
         if not out["ok"]:
             sys.exit(1)
