@@ -54,6 +54,10 @@ def main(argv=None):
                 # bounded fit dispatches (fault-envelope control, see
                 # PROFILE.md): trees per dispatch, as in the bench
                 kw["dispatch_trees"] = int(a.split("=", 1)[1]) or None
+            elif a == "fused":
+                # one device dispatch per config/batch (TPU round-trip
+                # amortization — SweepEngine fused mode)
+                kw["fused"] = True
             else:
                 raise ValueError(f"Unrecognized scores option {a!r}")
         write_scores(**kw)

@@ -169,8 +169,24 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
             y, preds, test_mask, project_ids, n_projects
         )
 
+    def run_all_one(x, y_raw, flaky_label, prep_code, bal_code, key,
+                    train_mask, test_mask, project_ids):
+        """The whole per-config CV pipeline — preprocess, resample, fit,
+        predict, confusion — as ONE program returning only counts [P, 3].
+
+        The round-3 TPU probe showed per-dispatch tunnel round-trips are
+        the entire per-config cost (a 25-tree x 10-fold growth chunk ran in
+        0.00 s steady while the multi-dispatch run_config took 13.18 s);
+        fusing the stages collapses ~7+ round-trips into one dispatch plus
+        one tiny host readback. Same composition of the same functions, so
+        results match the staged path (tests/test_sweep.py asserts count
+        equality)."""
+        forest, xp, y = fit_one(x, y_raw, flaky_label, prep_code, bal_code,
+                                key, train_mask)
+        return score_one(forest, xp, y, test_mask, project_ids)
+
     return (fit_one, score_one, prep_resample_one, fit_trees_chunk,
-            tree_keys_one)
+            tree_keys_one, run_all_one)
 
 
 def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
@@ -180,10 +196,13 @@ def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
     All config axes inside a family are traced ints; shapes depend only on
     (n, n_feat, spec) so each family compiles exactly once.
 
-    Returns (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys); the
-    last three drive the dispatch-chunked fit (SweepEngine.run_config with
-    ``dispatch_trees``): one prep+resample dispatch, then one bounded fit
-    dispatch per tree-key slice (compiled once per chunk width).
+    Returns (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys,
+    cv_all); cv_prep/cv_fit_chunk/cv_tree_keys drive the dispatch-chunked
+    fit (SweepEngine.run_config with ``dispatch_trees``): one prep+resample
+    dispatch, then one bounded fit dispatch per tree-key slice (compiled
+    once per chunk width). ``cv_all`` is the single-dispatch fusion of
+    cv_fit + cv_score (SweepEngine ``fused`` mode — the TPU-tunnel
+    round-trip amortization, see run_all_one).
     """
     fns = _make_config_fns(
         spec, n=n, n_projects=n_projects, cap=cap, max_depth=max_depth,
@@ -198,7 +217,7 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
     production sweep path (the reference forks a process per config,
     experiment.py:493-498; here a batch of configs is one SPMD program).
 
-    Returns (fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b):
+    Returns (fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b, all_b):
       fit_b(x, y_raw, fls [B], preps [B], bals [B], keys [B,2],
             train_masks [B,folds,N]) -> (forest [B,folds,...], xp [B,N,F'],
             y [B,N]) — all sharded over "config", left on device.
@@ -208,13 +227,15 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
       fit_chunk_b(xs, ys, ws, edges, tks [B,folds,c,2]) -> forest chunk:
       the dispatch-bounded twin of fit_b (SweepEngine dispatch_trees),
       with tree_keys_b(keys [B,2]) -> [B,folds,T,2] supplying the table.
+      all_b fuses fit_b + score_b into ONE SPMD dispatch returning only
+      counts [B,P,3] (SweepEngine ``fused`` mode).
     Fit and score are separate calls (not one fused program) so the
     reference's per-config T_TRAIN/T_TEST split (experiment.py:468-474)
     stays measurable, like ``make_cv_fns``. B must be a multiple of the
     mesh "config" axis size; within a shard, configs ride a vmap axis.
     """
     (fit_one, score_one, prep_resample_one, fit_trees_chunk,
-     tree_keys_one) = _make_config_fns(
+     tree_keys_one, run_all_one) = _make_config_fns(
         spec, n=n, n_projects=n_projects, max_depth=max_depth,
         n_folds=n_folds, tree_chunk=tree_chunk, grower=grower,
     )
@@ -244,6 +265,14 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
             lambda f, xpi, yi, tem: score_one(f, xpi, yi, tem, project_ids)
         )(forest, xp, y, test_masks)
 
+    def all_batch(x, y_raw, fls, preps, bals, keys, train_masks, test_masks,
+                  project_ids):
+        return jax.vmap(
+            lambda fl, prep, bal, key, trm, tem: run_all_one(
+                x, y_raw, fl, prep, bal, key, trm, tem, project_ids
+            )
+        )(fls, preps, bals, keys, train_masks, test_masks)
+
     pspec = P("config")
     forest_specs = jax.tree.map(lambda _: pspec, trees.Forest(
         *[0] * len(trees.Forest._fields)
@@ -264,7 +293,9 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
     tree_keys_b = smap(tree_keys_batch, (pspec,), pspec)
     score_b = smap(score_batch, (forest_specs, pspec, pspec, pspec, P()),
                    pspec)
-    return fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b
+    all_b = smap(all_batch, (P(), P(), pspec, pspec, pspec, pspec, pspec,
+                             pspec, P()), pspec)
+    return fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b, all_b
 
 
 def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
@@ -329,9 +360,13 @@ def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
         jax.block_until_ready((xs, ys, ws, edges, xp, y))
         timings["prep_s"] = round(time.time() - t0, 4)
     t0 = time.time()
-    tks = tree_keys_thunk()
+    # Key table to HOST once: slicing a device array per chunk costs one
+    # device dispatch per slice (round-3 attribution: tunnel round-trips,
+    # not compute, dominate per-config time). The table is [folds, T, 2]
+    # uint32 (~KBs); numpy slices upload with each chunk dispatch instead.
+    # Bit-identical: values unchanged, only residency moves.
+    tks = np.asarray(tree_keys_thunk())
     if timings is not None:
-        jax.block_until_ready(tks)
         timings["tree_keys_s"] = round(time.time() - t0, 4)
         timings["chunks_s"] = []
     n_folds = xs.shape[fold_axis]
@@ -391,7 +426,8 @@ class SweepEngine:
     def __init__(self, features, labels_raw, projects, project_names,
                  project_ids, *, mesh=None, max_depth=48, seed=0,
                  n_folds=None, tree_overrides=None, cv="stratified",
-                 dispatch_trees=None, dispatch_folds=None, grower=None):
+                 dispatch_trees=None, dispatch_folds=None, grower=None,
+                 fused=False):
         self.features = np.asarray(features, dtype=np.float32)
         self.labels_raw = np.asarray(labels_raw, dtype=np.int32)
         self.projects = projects
@@ -415,6 +451,17 @@ class SweepEngine:
         # axis 1 of its [B, folds, ...] shard tensors the same way
         # run_config slices axis 0 (_chunked_fit fold_axis).
         self.dispatch_folds = dispatch_folds
+        # fused=True runs each config (or batch) as ONE device dispatch —
+        # prep+resample+fit+predict+score fused, only counts [P,3] returned
+        # (run_all_one: tunnel round-trips dominate per-config cost on the
+        # TPU path). Takes precedence over the dispatch bounds; the
+        # reference's T_TRAIN/T_TEST split is not separable in this mode,
+        # so the combined wall lands in T_TRAIN with T_TEST=0.0 and the
+        # config is recorded in ``fused_configs`` (persisted by
+        # pipeline._write_timing_meta). Timed runs (``timings``) fall back
+        # to the staged path, which stays the attribution instrument.
+        self.fused = fused
+        self.fused_configs = set()
         # tests shrink ensembles: {"Random Forest": 10, ...}
         self.tree_overrides = tree_overrides or {}
         # Configs whose T_TRAIN/T_TEST are batch-amortized (every config
@@ -491,8 +538,8 @@ class SweepEngine:
         ``timings``: optional dict filled with per-stage walls (extra device
         syncs in timed mode only — see _chunked_fit)."""
         fl_name, fs_name, prep_name, bal_name, model_name = config_keys
-        (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys), cols = \
-            self._get_fns(fs_name, model_name)
+        (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys, cv_all), \
+            cols = self._get_fns(fs_name, model_name)
 
         x = jnp.asarray(self.features[:, cols])
         train_mask, test_mask = self._masks[fl_name]
@@ -509,6 +556,19 @@ class SweepEngine:
         )
         n_trees = self._spec(model_name).n_trees
         dc, df = self._dispatch_bounds(n_trees)
+
+        if self.fused and timings is None:
+            t0 = time.time()
+            counts = np.asarray(cv_all(  # np.asarray blocks on the result
+                *fit_args, jnp.asarray(test_mask),
+                jnp.asarray(self.project_ids),
+            ))
+            wall = time.time() - t0
+            self.fused_configs.add(tuple(config_keys))
+            scores, scores_total = format_scores(
+                counts, self.project_names, self.projects
+            )
+            return [wall / self.n_folds, 0.0, scores, scores_total]
 
         t0 = time.time()
         if dc is not None or df is not None:
@@ -575,7 +635,7 @@ class SweepEngine:
         fs_name, model_name = config_batch[0][1], config_batch[0][4]
         assert all(k[1] == fs_name and k[4] == model_name
                    for k in config_batch)
-        (fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b), cols = \
+        (fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b, all_b), cols = \
             self._get_sharded_fns(fs_name, model_name)
 
         d = self.mesh.devices.size
@@ -603,6 +663,22 @@ class SweepEngine:
         )
         n_trees = self._spec(model_name).n_trees
         dc, df = self._dispatch_bounds(n_trees)
+
+        if self.fused:
+            t0 = time.time()
+            counts = np.asarray(all_b(
+                *fit_args, jnp.asarray(tems), jnp.asarray(self.project_ids),
+            ))
+            wall = (time.time() - t0) / len(config_batch)
+            out = []
+            for i in range(len(config_batch)):
+                scores, scores_total = format_scores(
+                    counts[i], self.project_names, self.projects
+                )
+                out.append([wall / self.n_folds, 0.0, scores, scores_total])
+            self.fused_configs.update(tuple(k) for k in config_batch)
+            self.amortized_configs.update(tuple(k) for k in config_batch)
+            return out
 
         t0 = time.time()
         if dc is not None or df is not None:

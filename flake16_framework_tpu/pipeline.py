@@ -9,12 +9,14 @@ Differences from the reference, by design:
   experiment.py:515 — fixed, not reproduced; SURVEY.md §2 row 17).
 """
 
+import functools
 import os
 import pickle
 import sys
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from flake16_framework_tpu import config as cfg
@@ -36,7 +38,7 @@ def write_scores(tests_file=TESTS_FILE, out_file=None, *,
                  max_depth=48, tree_overrides=None, configs=None,
                  checkpoint_every=12, progress_out=sys.stdout,
                  cv="stratified", mesh=None, profile_dir=None,
-                 dispatch_trees=None, dispatch_folds=None):
+                 dispatch_trees=None, dispatch_folds=None, fused=False):
     """Run the (216-config x 10-fold) sweep and pickle the reference-schema
     scores dict. Resumes from an existing partial ``out_file``.
 
@@ -59,6 +61,7 @@ def write_scores(tests_file=TESTS_FILE, out_file=None, *,
         feats, labels, projects, names, pids, max_depth=max_depth,
         tree_overrides=tree_overrides, cv=cv, mesh=mesh,
         dispatch_trees=dispatch_trees, dispatch_folds=dispatch_folds,
+        fused=fused,
     )
 
     ledger = {}
@@ -74,6 +77,13 @@ def write_scores(tests_file=TESTS_FILE, out_file=None, *,
             f"[{i}/{total}] {', '.join(keys)} ({el:.1f}s elapsed)\n"
         )
         if i % checkpoint_every == 0:
+            # Sidecar FIRST, pickle second: the sidecar merges supersets,
+            # so a stamp for a config not yet in the pickle is harmless —
+            # while a pickle with fused/amortized clocks and no stamp is
+            # the exact ambiguity the sidecar exists to prevent (round-4
+            # advisor). A crash between the two writes is safe either way.
+            _write_timing_meta(out_file, engine.amortized_configs,
+                               engine.fused_configs)
             _dump(live_scores, out_file)
 
     if profile_dir is not None:
@@ -84,14 +94,17 @@ def write_scores(tests_file=TESTS_FILE, out_file=None, *,
         scores_all = engine.run_grid(configs, ledger=ledger,
                                      progress=progress)
     _dump(scores_all, out_file)
-    _write_timing_meta(out_file, engine.amortized_configs)
+    _write_timing_meta(out_file, engine.amortized_configs,
+                       engine.fused_configs)
     return scores_all
 
 
-def _write_timing_meta(out_file, amortized_configs):
+def _write_timing_meta(out_file, amortized_configs, fused_configs=()):
     """Persist timing provenance beside the pickle: which configs'
     T_TRAIN/T_TEST are batch-amortized (mesh SPMD batches attribute the
-    batch wall evenly — SweepEngine.run_config_batch). The pickle itself
+    batch wall evenly — SweepEngine.run_config_batch) and which carry a
+    fused combined clock (single-dispatch mode: whole-config wall in
+    T_TRAIN, T_TEST=0.0 — SweepEngine ``fused``). The pickle itself
     keeps the exact 4-element reference value schema, because the
     reference's own readers unpack strictly (experiment.py:564,578) and
     must keep working on our artifact; the sidecar is the stamp a reader
@@ -101,18 +114,24 @@ def _write_timing_meta(out_file, amortized_configs):
     import json
 
     meta_file = out_file + ".meta.json"
-    known = set()
+    known, known_fused = set(), set()
     if os.path.exists(meta_file):
         with open(meta_file) as fd:
-            known = {tuple(k) for k in json.load(fd)["batch_amortized"]}
+            prev = json.load(fd)
+        known = {tuple(k) for k in prev["batch_amortized"]}
+        known_fused = {tuple(k) for k in prev.get("fused_combined", [])}
     merged = sorted(known | {tuple(k) for k in amortized_configs})
+    merged_fused = sorted(known_fused | {tuple(k) for k in fused_configs})
     with open(meta_file + ".tmp", "w") as fd:
         json.dump({
             "schema": "flake16-timing-meta-v1",
-            "note": ("configs listed here have batch-amortized "
+            "note": ("configs under batch_amortized have batch-amortized "
                      "T_TRAIN/T_TEST (mesh batch wall divided evenly); "
+                     "configs under fused_combined ran as one fused "
+                     "dispatch (combined wall in T_TRAIN, T_TEST=0.0); "
                      "all other configs carry true per-config clocks"),
             "batch_amortized": [list(k) for k in merged],
+            "fused_combined": [list(k) for k in merged_fused],
         }, fd, indent=1)
     os.replace(meta_file + ".tmp", meta_file)
 
@@ -124,10 +143,34 @@ def _dump(obj, path):
     os.replace(tmp, path)
 
 
+@functools.lru_cache(maxsize=None)
+def _fused_shap_fit(n, spec, max_depth, max_nodes, use_hist):
+    """One jitted program for the SHAP stage's preprocess -> transform ->
+    resample -> fit chain (cached per shape/spec so repeat calls hit the
+    trace cache). The staged path dispatches each stage separately — ~5+
+    device round-trips before the explain even starts, which is the whole
+    cost on the TPU tunnel (see SweepEngine fused mode)."""
+    def f(x, y, prep, bal, key):
+        mu, wmat = fit_preprocess(x, prep)
+        xp = transform(x, mu, wmat)
+        kb, kf = jax.random.split(key)
+        xs, ys, ws = resample(xp, y, jnp.ones(x.shape[0], jnp.float32),
+                              bal, kb, 2 * n)
+        kw = dict(n_trees=spec.n_trees, bootstrap=spec.bootstrap,
+                  random_splits=spec.random_splits,
+                  sqrt_features=spec.sqrt_features,
+                  max_depth=max_depth, max_nodes=max_nodes)
+        forest = (trees.fit_forest_hist if use_hist
+                  else trees.fit_forest)(xs, ys, ws, kf, **kw)
+        return xp, forest
+
+    return jax.jit(f)
+
+
 def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
                     tree_overrides=None, seed=0, sample_chunk=512,
                     impl="auto", n_explain=None, shap_tree_chunk=None,
-                    fit_dispatch_trees=None, timings=None):
+                    fit_dispatch_trees=None, timings=None, fused_fit=False):
     """One SHAP config (reference get_shap experiment.py:504-517): preprocess
     full data, fit on the balanced full set, explain every original sample
     (or the first ``n_explain`` — benchmark sizing). Returns the class-0
@@ -137,7 +180,10 @@ def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
     into per-tree-slice dispatches (treeshap.forest_shap_class0).
     ``timings``: optional dict filled with per-stage walls (prep/resample/
     fit/explain; extra device syncs in timed mode only — the TPU probe's
-    attribution instrument, same shape as SweepEngine.run_config)."""
+    attribution instrument, same shape as SweepEngine.run_config).
+    ``fused_fit`` runs preprocess+resample+fit as ONE jitted program
+    (_fused_shap_fit — TPU round-trip amortization); ignored in timed mode,
+    where the per-stage split is the point."""
     def _mark(stage, t0, *sync):
         if timings is not None:
             for v in sync:
@@ -155,6 +201,17 @@ def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
     n = x.shape[0]
 
     key = jax.random.PRNGKey(seed)
+    if fused_fit and timings is None:
+        fit_fn = _fused_shap_fit(n, spec, max_depth, 4 * n,
+                                 spec.n_trees > 1)
+        xp, forest = fit_fn(x, y, prep, bal, key)
+        x_explain = xp if n_explain is None else xp[:n_explain]
+        return np.asarray(
+            treeshap.forest_shap_class0(forest, x_explain,
+                                        sample_chunk=sample_chunk,
+                                        impl=impl,
+                                        tree_chunk=shap_tree_chunk)
+        )
     t0 = time.time()
     mu, wmat = jax.jit(fit_preprocess)(x, prep)
     xp = transform(x, mu, wmat)

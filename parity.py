@@ -235,6 +235,30 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
     cache = load_cache(sklearn_cache, "sklearn") if sklearn_cache else None
     exact_cache = (load_cache(ours_exact_cache, "ours-exact")
                    if ours_exact_cache else None)
+    run_prec = None
+    if exact_cache is not None:
+        # Precision is part of cache validity, not just provenance: an
+        # f32-built cache consumed where the direct path would compute f64
+        # reproduces the exact-grower's known f32 RF degradation (x64
+        # header comment) under an f64-labeled record. The degradation
+        # direction is an error; the reverse (f64 cache on an f32 run) is
+        # strictly better data and only warned. Per-seed enforcement
+        # happens at consumption (the top-level "precision" key is absent
+        # from mixed-provenance caches — exact_seed_cache.py).
+        import jax
+
+        run_prec = ("f64" if jax.default_backend() == "cpu"
+                    and jax.config.jax_enable_x64 else "f32")
+        cache_prec = exact_cache.get("precision")
+        if cache_prec is not None and cache_prec != run_prec:
+            if run_prec == "f64" and cache_prec == "f32":
+                raise AssertionError(
+                    f"ours-exact cache is {cache_prec} but this run's "
+                    f"direct path computes {run_prec} — rebuild the cache "
+                    "(f32 exact-tier RF is the documented parity trap)")
+            print(f"note: ours-exact cache precision {cache_prec} != "
+                  f"run precision {run_prec} (higher-precision cache "
+                  "consumed on a lower-precision run)", flush=True)
     feats, labels, pids = make_dataset(
         n_tests=n_tests, seed=data_seed, nod_bump=nod_bump, od_bump=od_bump,
         noise_sigma=noise_sigma,
@@ -295,6 +319,18 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
                     f"ours-exact cache has {len(got)} seeds for {keys}, "
                     f"need {kx} (lower PARITY_K_EXACT or extend the cache)"
                 )
+                # per-seed precision check on the CONSUMED slice: a
+                # mixed-provenance cache (no top-level "precision") must
+                # not smuggle f32 seeds into an f64 run
+                seed_prov = exact_cache.get(
+                    "seed_provenance", {}).get("/".join(keys), [])
+                if run_prec == "f64":
+                    bad = [p for p in seed_prov[:kx]
+                           if p.get("precision") == "f32"]
+                    assert not bad, (
+                        f"ours-exact cache seeds {[p['seed'] for p in bad]}"
+                        f" for {keys} are f32 but this run computes f64 — "
+                        "rebuild those seeds")
                 ox = np.array(got[:kx])
                 src = "cache:" + os.path.basename(ours_exact_cache) + (
                     f" ({exact_cache['precision']})"
@@ -307,6 +343,9 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
             exact_entry = side(ox)
             exact_entry["grower"] = "exact"
             exact_entry["ours_source"] = src
+            # the REQUESTED seed count, so a record judged on an
+            # operator-lowered PARITY_K_EXACT is visibly under-default
+            exact_entry["k_exact_requested"] = kx
             # criterion row = exact tier; production tier published beside
             entry = dict(exact_entry, default_tier=entry)
         report["/".join(keys)] = entry
@@ -363,12 +402,25 @@ def main():
         import jax
 
         tol = 0.01
+        k_exact = int(os.environ.get("PARITY_K_EXACT", "6"))
         out = {"tier": "full", "n_tests": 4000, "n_trees": 100,
                "tolerance": tol, "configs": rep,
                # provenance: results are backend-independent by design
                # (bit-pinned hist formulations, backend-deterministic PRNG)
                # but the record must say which backend ran the ours side
                "ours_backend": jax.default_backend(),
+               # Self-describing tier flags (round-4 advisor): top-level
+               # ok judges the CRITERION (exact) tier; whether the shipped
+               # production (hist) tier also fits the tolerance is stated
+               # here so a machine consumer reading only ok+tolerance
+               # cannot mistake one for the other. Seed-count provenance:
+               # an ok judged on fewer exact seeds than the 6-seed default
+               # is visibly under-default.
+               "criterion_tier": "exact",
+               "default_tier_within_tol": all(
+                   abs(v["default_tier"]["delta"]) <= tol
+                   for v in rep.values() if "default_tier" in v),
+               "k_exact": k_exact, "k_exact_default": 6,
                "ok": all(abs(v["delta"]) <= tol for v in rep.values())}
         # Atomic replace: a kill mid-dump must never corrupt an existing
         # green record.

@@ -128,3 +128,23 @@ def test_shap_timed_mode_is_results_neutral():
     timed = pipeline.shap_for_config(keys, feats, labels, timings=tm, **kw)
     np.testing.assert_array_equal(plain, timed)
     assert {"prep_s", "resample_s", "fit_s", "explain_s"} <= set(tm)
+
+
+def test_shap_fused_fit_matches_staged():
+    # fused_fit runs preprocess+resample+fit as one jitted program (the
+    # TPU round-trip amortization); the explanation must match the staged
+    # path exactly — same ops, same keys, one trace boundary.
+    from flake16_framework_tpu import pipeline
+    from flake16_framework_tpu.utils.synth import make_dataset
+
+    feats, labels, _ = make_dataset(n_tests=150, seed=3)
+    for keys in [
+        ("NOD", "Flake16", "Scaling", "SMOTE Tomek", "Extra Trees"),
+        ("OD", "Flake16", "None", "None", "Decision Tree"),
+    ]:
+        kw = dict(tree_overrides={"Extra Trees": 5}, n_explain=40,
+                  impl="xla")
+        a = pipeline.shap_for_config(keys, feats, labels, **kw)
+        b = pipeline.shap_for_config(keys, feats, labels, fused_fit=True,
+                                     **kw)
+        np.testing.assert_array_equal(a, b)

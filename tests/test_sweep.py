@@ -376,3 +376,61 @@ def test_pca_config_eigh_impl_inside_cv_program(monkeypatch):
     # lands inside the ~1e-6 basis difference; wholesale disagreement
     # means the eigh basis broke inside the traced program.
     assert np.abs(tot_svd - tot_eigh).sum() <= 6, (tot_svd, tot_eigh)
+
+
+def test_fused_run_config_matches_staged(engine):
+    # Fused single-dispatch mode (prep+resample+fit+predict+score as ONE
+    # program — the TPU tunnel round-trip amortization) must reproduce the
+    # staged path's counts exactly on every model family: same functions,
+    # same keys, just one jit boundary instead of several.
+    fused = _make_engine(fused=True)
+    for keys in [
+        ("NOD", "Flake16", "None", "None", "Decision Tree"),
+        ("OD", "Flake16", "Scaling", "SMOTE", "Random Forest"),
+        ("NOD", "FlakeFlagger", "PCA", "ENN", "Extra Trees"),
+    ]:
+        a = engine.run_config(keys)
+        b = fused.run_config(keys)
+        assert a[3] == b[3], keys
+        assert a[2] == b[2], keys
+        # combined clock: whole wall in T_TRAIN, T_TEST pinned to 0.0,
+        # provenance recorded for the timing sidecar
+        assert b[1] == 0.0 and b[0] > 0
+        assert tuple(keys) in fused.fused_configs
+    assert not engine.fused_configs  # staged engine: true clocks
+
+
+def test_fused_timed_mode_falls_back_to_staged(engine):
+    # timings= is the attribution instrument; fused mode defers to the
+    # staged path there so the per-stage split stays measurable.
+    fused = _make_engine(fused=True)
+    keys = ("NOD", "Flake16", "None", "None", "Decision Tree")
+    tm = {}
+    r = fused.run_config(keys, timings=tm)
+    assert "score_s" in tm and r[1] > 0
+    assert tuple(keys) not in fused.fused_configs
+
+
+def test_fused_batch_matches_staged(engine):
+    # The fused SPMD batch (all_b: one dispatch for a whole same-family
+    # config batch over the mesh) must match per-config staged results.
+    feats, labels, pids = make_dataset(n_tests=240, n_projects=6, seed=11)
+    names = [f"project{p:02d}" for p in range(6)]
+    projects = np.array([names[p] for p in pids])
+    fused = sweep.SweepEngine(
+        feats, labels, projects, names, pids, max_depth=24,
+        mesh=sweep.default_mesh(), fused=True,
+    )
+    configs = [
+        ("NOD", "Flake16", p, b, "Decision Tree")
+        for p, b in [("None", "None"), ("Scaling", "None"), ("PCA", "None"),
+                     ("None", "Tomek Links"), ("Scaling", "ENN")]
+    ]
+    sharded = fused.run_grid(configs)
+    for keys in configs:
+        res = engine.run_config(keys)
+        assert sharded[keys][3][:3] == res[3][:3], keys
+        assert {k: v[:3] for k, v in sharded[keys][2].items()} == {
+            k: v[:3] for k, v in res[2].items()
+        }, keys
+        assert tuple(keys) in fused.fused_configs

@@ -183,3 +183,54 @@ def test_exact_seed_cache_checkpoints_per_seed(tmp_path, monkeypatch):
         raise AssertionError("should have refused the mismatched cache")
     except AssertionError as e:
         assert "move it aside" in str(e)
+
+
+def test_pick_tuned_env_fused_arms(tmp_path, monkeypatch):
+    """Four arms of the "batch" knob: staged per-config (rf_full ->
+    BENCH_FUSED=0), fused per-config (rf_fused -> empty env, fused is the
+    bench default), staged batch (rf_batch -> BENCH_BATCH+BENCH_FUSED=0),
+    fused batch (rf_batch_fused -> BENCH_BATCH only)."""
+    rw = _load()
+    monkeypatch.setattr(rw, "REPO", str(tmp_path))
+    (tmp_path / "_scratch").mkdir()
+    path = tmp_path / "_scratch" / "hw_probe.jsonl"
+
+    def write(recs):
+        with open(path, "w") as fd:
+            for rec in recs:
+                fd.write(json.dumps(rec) + "\n")
+
+    base = [
+        {"step": "rf_full", "ok": True, "out": ["steady_s 13.0"]},
+        {"step": "rf_batch", "ok": True,
+         "out": ["steady_s 8.0 per_config_s 4.0 (2 configs)"]},
+    ]
+    # fused per-config fastest -> no knobs at all (it IS the default)
+    write(base + [
+        {"step": "rf_fused", "ok": True, "out": ["steady_s 1.0"]},
+        {"step": "rf_batch_fused", "ok": True,
+         "out": ["steady_s 4.0 per_config_s 2.0 (2 configs)"]},
+    ])
+    env = rw.pick_tuned_env(0)
+    assert "BENCH_BATCH" not in env and "BENCH_FUSED" not in env
+    # fused batch fastest -> BENCH_BATCH, fused stays default-on
+    write(base + [
+        {"step": "rf_fused", "ok": True, "out": ["steady_s 3.0"]},
+        {"step": "rf_batch_fused", "ok": True,
+         "out": ["steady_s 1.0 per_config_s 0.5 (2 configs)"]},
+    ])
+    env = rw.pick_tuned_env(0)
+    assert env.get("BENCH_BATCH") == "2" and "BENCH_FUSED" not in env
+    # staged per-config fastest -> BENCH_FUSED=0 explicitly
+    write([
+        {"step": "rf_full", "ok": True, "out": ["steady_s 1.0"]},
+        {"step": "rf_fused", "ok": True, "out": ["steady_s 2.0"]},
+    ])
+    env = rw.pick_tuned_env(0)
+    assert env.get("BENCH_FUSED") == "0" and "BENCH_BATCH" not in env
+    # staged batch fastest -> both knobs
+    write(base + [
+        {"step": "rf_fused", "ok": True, "out": ["steady_s 9.0"]},
+    ])
+    env = rw.pick_tuned_env(0)
+    assert env.get("BENCH_BATCH") == "2" and env.get("BENCH_FUSED") == "0"

@@ -119,6 +119,36 @@ print('pca_svd_compile_s', round(time.time() - t0, 2))
 t0 = time.time(); mu, w = jax.block_until_ready(fn(x, jnp.int32(PREP_PCA)))
 print('pca_svd_steady_s', round(time.time() - t0, 3))
 """,
+    # Fused single-dispatch RF config (SweepEngine fused=True): the whole
+    # prep+resample+fit+predict+score pipeline as ONE device program —
+    # the round-trip amortization bet from the round-3 attribution
+    # (rf_full steady 13.18 s vs ~0 s growth compute). steady_s here vs
+    # rf_full's steady_s is the A/B that decides BENCH_FUSED.
+    "rf_fused": """
+from probe_common import make_engine
+eng = make_engine(fused=True)
+import time
+keys = ('NOD', 'Flake16', 'Scaling', 'SMOTE', 'Random Forest')
+t0 = time.time(); eng.run_config(keys); print('compile_s', round(time.time() - t0, 2))
+t0 = time.time(); r = eng.run_config(keys); print('steady_s', round(time.time() - t0, 2))
+t0 = time.time(); r = eng.run_config(keys); print('steady2_s', round(time.time() - t0, 2))
+""",
+    # Fused + config-batched: TWO same-family configs in ONE SPMD dispatch
+    # (all_b). The per-config floor of the fused design.
+    "rf_batch_fused": """
+from probe_common import make_engine
+import time
+eng = make_engine(mesh=True, fused=True)
+batch = [('NOD', 'Flake16', 'Scaling', 'SMOTE', 'Random Forest'),
+         ('OD', 'Flake16', 'Scaling', 'SMOTE', 'Random Forest')]
+t0 = time.time(); eng.run_config_batch(batch)
+print('compile_s', round(time.time() - t0, 2))
+t0 = time.time(); r = eng.run_config_batch(batch)
+w = time.time() - t0
+print('steady_s', round(w, 2),
+      'per_config_s', round(w / len(batch), 2),
+      '(%d configs)' % len(batch))
+""",
     # Config-batched SPMD path (run_config_batch / shard_map) on a
     # 1-device mesh: TWO same-family RF configs ride the within-shard vmap
     # axis of ONE program. Proves the production sharded path on real
@@ -195,9 +225,13 @@ for line in predict_ab():
 # LAST: a wedge there still leaves every other measurement on the record.
 # prep_pca runs early — cheap, and it attributes a PCA-stage wedge by
 # name. prep_pca_svd is deliberately absent (opt-in).
+# The fused arms run AFTER the staged ones they A/B against: they
+# deliberately maximize single-dispatch duration (the PROFILE.md wedge
+# pattern), and a fused wedge must not cost the staged rf_full/rf_batch
+# measurements pick_tuned_env needs to decide BENCH_FUSED.
 DEFAULT_STEPS = ["matmul", "prep_pca", "dt", "rf_chunk", "rf_full",
-                 "rf_batch", "et_enn", "shap", "shap_equiv", "predict_ab",
-                 "et_full"]
+                 "rf_batch", "rf_fused", "rf_batch_fused", "et_enn", "shap",
+                 "shap_equiv", "predict_ab", "et_full"]
 
 
 # Every step reports the backend jax ACTUALLY initialized — authoritative

@@ -72,7 +72,7 @@ def trace_fit():
     eng = make_engine()
     keys5 = ("NOD", "Flake16", "Scaling", "SMOTE", "Random Forest")
     fl_name, fs_name, prep_name, bal_name, model_name = keys5
-    (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys), cols = \
+    (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys, cv_all), cols = \
         eng._get_fns(fs_name, model_name)
     x = jnp.asarray(eng.features[:, cols])
     train_mask, _ = eng._masks[fl_name]

@@ -22,14 +22,14 @@ DISPATCH = bench.DISPATCH_TREES
 N_EXPLAIN = min(bench.SHAP_EXPLAIN, N_TESTS)
 
 
-def make_engine(mesh=False):
+def make_engine(mesh=False, fused=False):
     from flake16_framework_tpu.parallel import sweep
 
     feats, labels, projects, names, pids = bench.make_data(N_TESTS)
     overrides = {"Random Forest": N_TREES, "Extra Trees": N_TREES}
     return sweep.SweepEngine(
         feats, labels, projects, names, pids, tree_overrides=overrides,
-        dispatch_trees=DISPATCH,
+        dispatch_trees=DISPATCH, fused=fused,
         mesh=sweep.default_mesh() if mesh else None)
 
 
@@ -42,7 +42,7 @@ def chunk_fit_times(config_keys):
 
     eng = make_engine()
     fl_name, fs_name, prep_name, bal_name, model_name = config_keys
-    (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys), cols = \
+    (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys, cv_all), cols = \
         eng._get_fns(fs_name, model_name)
     x = jnp.asarray(eng.features[:, cols])
     train_mask, _ = eng._masks[fl_name]
@@ -88,6 +88,7 @@ def shap_times():
     keys = cfg.SHAP_CONFIGS[0]
     kw = dict(tree_overrides=overrides, n_explain=N_EXPLAIN,
               shap_tree_chunk=DISPATCH, fit_dispatch_trees=DISPATCH,
+              fused_fit=bench.BENCH_FUSED,
               impl=os.environ.get("BENCH_SHAP_IMPL", "auto"))
     t0 = time.time()
     pipeline.shap_for_config(keys, feats, labels, **kw)

@@ -156,17 +156,21 @@ def pick_tuned_env(since_pos):
                     else:
                         consider("dispatch", per_tree, env_frag or
                                  {"BENCH_DISPATCH_TREES": tag.rsplit("d", 1)[1]})
-                elif tag == "rf_full":
-                    # One "batch" kind, two arms: per-config path (empty
-                    # env = no batching) vs the config-batched SPMD path
-                    # below; min per-config steady wins the re-bench knob.
+                elif tag in ("rf_full", "rf_fused"):
+                    # One "batch" kind, four arms: staged per-config
+                    # (rf_full -> BENCH_FUSED=0), fused per-config
+                    # (rf_fused -> empty env: fused IS the bench default),
+                    # and the two config-batched arms below; min per-config
+                    # steady wins the re-bench knob.
                     try:
                         steady = float(
                             out.split("steady_s ", 1)[1].split()[0])
                     except (IndexError, ValueError):
                         continue
-                    consider("batch", steady, {})
-                elif tag == "rf_batch":
+                    consider("batch", steady,
+                             {"BENCH_FUSED": "0"} if tag == "rf_full"
+                             else {})
+                elif tag in ("rf_batch", "rf_batch_fused"):
                     # "per_config_s X (N configs)" — N is parsed so the
                     # knob always matches the batch size the probe measured.
                     try:
@@ -174,7 +178,10 @@ def pick_tuned_env(since_pos):
                         steady, n_cfg = float(part[0]), int(part[1].strip("("))
                     except (IndexError, ValueError):
                         continue
-                    consider("batch", steady, {"BENCH_BATCH": str(n_cfg)})
+                    frag = {"BENCH_BATCH": str(n_cfg)}
+                    if tag == "rf_batch":
+                        frag["BENCH_FUSED"] = "0"
+                    consider("batch", steady, frag)
                 elif tag.startswith("shap_"):
                     try:
                         steady = float(
