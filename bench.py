@@ -466,6 +466,10 @@ def run_worker(n_tests, n_trees, env_extra=None):
             if not sel.select(timeout=min(timeout, 5.0)):
                 continue
             while True:  # drain everything currently readable
+                if time.time() >= deadline:
+                    # a worker spewing stdout in a tight loop (wedged
+                    # runtime retry-printing) must not outrun the timeout
+                    return reap("timeout")
                 try:
                     chunk = os.read(fd, 65536)
                 except BlockingIOError:

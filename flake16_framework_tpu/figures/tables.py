@@ -26,6 +26,11 @@ def cell_corr(cell):
     if isinstance(cell, str):
         return cell
     if isinstance(cell, float):
+        if np.isnan(cell):
+            # degenerate (zero-variance) feature columns have no defined
+            # rank correlation; the study data never produces these, so
+            # the byte-compat contract is unaffected
+            return "--"
         return "\\cellcolor{gray!%d} %.2f" % (int(50 * abs(cell)), cell)
     return ""
 
@@ -76,6 +81,11 @@ def req_runs_coords(req_runs):
         for m in marks
     ]
     total = counts[-1]
+    if not total:
+        # a dataset with no tests of this flaky type renders an empty
+        # plot rather than dividing by zero (the reference's study data
+        # always has both types; arbitrary datasets may not)
+        return ""
     return " ".join(f"({m},{c / total})" for m, c in zip(marks, counts))
 
 

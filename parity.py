@@ -321,16 +321,25 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
                 )
                 # per-seed precision check on the CONSUMED slice: a
                 # mixed-provenance cache (no top-level "precision") must
-                # not smuggle f32 seeds into an f64 run
+                # not smuggle f32 seeds into an f64 run — and a cache with
+                # NO per-seed provenance at all is rejected on f64 runs
+                # unless its uniform precision says f64 (same distrust
+                # principle as load_cache's params check)
                 seed_prov = exact_cache.get(
                     "seed_provenance", {}).get("/".join(keys), [])
-                if run_prec == "f64":
+                if run_prec == "f64" and \
+                        exact_cache.get("precision") != "f64":
+                    assert len(seed_prov) >= kx, (
+                        f"ours-exact cache for {keys} lacks per-seed "
+                        "precision provenance and is not uniformly f64 — "
+                        "cannot rule out f32 seeds on an f64 run; "
+                        "regenerate with tools/exact_seed_cache.py")
                     bad = [p for p in seed_prov[:kx]
-                           if p.get("precision") == "f32"]
+                           if p.get("precision") != "f64"]
                     assert not bad, (
                         f"ours-exact cache seeds {[p['seed'] for p in bad]}"
-                        f" for {keys} are f32 but this run computes f64 — "
-                        "rebuild those seeds")
+                        f" for {keys} are not f64 but this run computes "
+                        "f64 — rebuild those seeds")
                 ox = np.array(got[:kx])
                 src = "cache:" + os.path.basename(ours_exact_cache) + (
                     f" ({exact_cache['precision']})"

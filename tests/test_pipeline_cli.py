@@ -148,3 +148,17 @@ def test_shap_fused_fit_matches_staged():
         b = pipeline.shap_for_config(keys, feats, labels, fused_fit=True,
                                      **kw)
         np.testing.assert_array_equal(a, b)
+
+
+def test_cli_scores_option_parsing(monkeypatch):
+    # the scores verb's option grammar (lopo/profile=/dispatch=/fused) maps
+    # to write_scores kwargs; unknown options raise like the reference CLI
+    import flake16_framework_tpu.__main__ as cli
+
+    seen = {}
+    monkeypatch.setattr("flake16_framework_tpu.pipeline.write_scores",
+                        lambda **kw: seen.update(kw) or {})
+    cli.main(["scores", "fused", "dispatch=7", "lopo"])
+    assert seen == {"fused": True, "dispatch_trees": 7, "cv": "lopo"}
+    with pytest.raises(ValueError, match="Unrecognized scores option"):
+        cli.main(["scores", "nope"])

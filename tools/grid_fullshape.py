@@ -1,0 +1,92 @@
+"""Production-shape full-grid wall: the ENTIRE 216-config x 10-fold sweep
+at study scale (N=4000 tests, 100-tree ensembles — BASELINE.json shapes),
+with the per-config ledger on, recording wall-clock and peak RSS.
+
+VERDICT r4 item 9: every full-grid proof so far ran at reduced shapes;
+this bounds the TPU projection and exercises memory at real shape. Runs on
+whatever backend jax gives (CPU here — the TPU path is the watcher
+chain's grid_tpu.py); either way the fused single-dispatch engine is the
+same code the bench measures.
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python tools/grid_fullshape.py
+
+Resumable: the ledger pickle checkpoints after every config; re-running
+skips completed configs and accumulates wall across sessions in the
+sidecar record.
+"""
+
+import json
+import os
+import pickle
+import resource
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_TESTS = int(os.environ.get("GRID_N_TESTS", "4000"))
+SEED = 7
+LEDGER = os.path.join(REPO, "_scratch", "grid_fullshape.pkl")
+RECORD = os.path.join(REPO, "_scratch", "grid_fullshape.json")
+
+
+def main():
+    import jax
+
+    import bench
+    from flake16_framework_tpu.parallel import sweep
+
+    bench.configure_jax_cache()
+    feats, labels, projects, names, pids = bench.make_data(N_TESTS)
+    engine = sweep.SweepEngine(feats, labels, projects, names, pids,
+                               fused=True)
+
+    ledger = {}
+    if os.path.exists(LEDGER):
+        with open(LEDGER, "rb") as fd:
+            ledger = pickle.load(fd)
+        print(f"resuming: {len(ledger)} configs already done", flush=True)
+
+    prev_wall = 0.0
+    if os.path.exists(RECORD):
+        with open(RECORD) as fd:
+            prev_wall = json.load(fd).get("wall_s", 0.0)
+
+    t0 = time.time()
+
+    def write_record(n_done):
+        # banked at EVERY checkpoint, not only on clean exit: a killed
+        # session's hours must still be in wall_s when the next session
+        # resumes (resumability is the point of the ledger)
+        rec = {
+            "n_tests": N_TESTS, "n_trees": 100, "n_configs": n_done,
+            "backend": jax.default_backend(),
+            "fused": True,
+            "wall_s": round(prev_wall + time.time() - t0, 1),
+            "peak_rss_mb": resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss // 1024,
+            "complete": n_done == 216,
+        }
+        with open(RECORD + ".tmp", "w") as fd:
+            json.dump(rec, fd, indent=1)
+        os.replace(RECORD + ".tmp", RECORD)
+        return rec
+
+    def progress(i, total, keys, live):
+        el = time.time() - t0
+        print(f"[{i}/{total}] {'/'.join(keys)} ({el:.0f}s, "
+              f"rss {resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024} MB)",
+              flush=True)
+        with open(LEDGER + ".tmp", "wb") as fd:
+            pickle.dump(live, fd)
+        os.replace(LEDGER + ".tmp", LEDGER)
+        write_record(len(live))
+
+    scores = engine.run_grid(ledger=ledger, progress=progress)
+    print(json.dumps(write_record(len(scores))), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -114,13 +114,160 @@ def trace_shap():
     summarize(out_dir)
 
 
+# Peak dense-matmul throughput per chip, FLOP/s (public figures; bf16 for
+# the MXU path). The v5e figure is the one this project benches against.
+PEAK_FLOPS = {"v5e": 197e12, "v4": 275e12, "v5p": 459e12}
+
+
+def _cost_flops(compiled):
+    """XLA cost-model FLOPs of a compiled executable (dict in newer jax,
+    list-of-dicts in older). None when the model reports nothing (e.g. a
+    program that is all custom calls)."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    f = ca.get("flops")
+    return float(f) if f else None
+
+
+def _steady_s(thunk, reps=3):
+    import time
+
+    import jax
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.time()
+        jax.block_until_ready(thunk())
+        best = min(best, time.time() - t0)
+    return best
+
+
+def trace_mfu():
+    """Achieved FLOP/s + %-of-peak for the two hot programs (VERDICT r4
+    item 6: 'actually fast, not just correct' needs compute-utilization
+    numbers, not only wall-clock speedups).
+
+    - fit_chunk: the MXU histogram grower's level-step program. FLOPs from
+      XLA's own cost model (the analytic count of the lowered HLO).
+    - shap: the explain program. The Pallas kernel is a custom call XLA's
+      cost model cannot count, so its row reports EFFECTIVE FLOP/s — the
+      XLA formulation's cost-model FLOPs divided by the measured wall of
+      whichever impl ran (throughput relative to the same algorithmic
+      work), labeled as such.
+
+    Appends one JSON line per program to _scratch/hw_trace_mfu.jsonl."""
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from probe_common import (DISPATCH, N_EXPLAIN, N_TESTS, N_TREES,
+                              make_engine)
+    from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.ops import treeshap
+
+    backend = jax.default_backend()
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    peak = PEAK_FLOPS.get(gen) if backend == "tpu" else None
+    out_path = os.path.join(REPO, "_scratch", "hw_trace_mfu.jsonl")
+
+    def emit(name, flops, wall_s, note):
+        rec = {"program": name, "backend": backend,
+               "flops_cost_model": flops, "wall_s": round(wall_s, 4),
+               "flops_per_s": round(flops / wall_s, 3) if flops else None,
+               "note": note}
+        if peak and flops:
+            rec["peak_flops"] = peak
+            rec["pct_of_peak"] = round(100 * flops / wall_s / peak, 3)
+        # bank IMMEDIATELY: a tunnel wedge in a later program (the fused
+        # arms maximize single-dispatch duration) must not lose the
+        # measurements already taken — same convention as bench's
+        # _persist_stage and the per-seed exact cache
+        with open(out_path, "a") as fd:
+            fd.write(json.dumps(rec) + "\n")
+        print(json.dumps(rec), flush=True)
+
+    # --- fit chunk (hist grower level steps) ------------------------------
+    eng = make_engine()
+    keys5 = ("NOD", "Flake16", "Scaling", "SMOTE", "Random Forest")
+    fl_name, fs_name, prep_name, bal_name, model_name = keys5
+    (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys, cv_all), cols = \
+        eng._get_fns(fs_name, model_name)
+    x = jnp.asarray(eng.features[:, cols])
+    train_mask, tem = eng._masks[fl_name]
+    key = jax.random.PRNGKey(0)
+    args = (x, jnp.asarray(eng.labels_raw),
+            jnp.int32(cfg.FLAKY_TYPES[fl_name]),
+            jnp.int32(cfg.PREPROCESSINGS[prep_name]),
+            jnp.int32(cfg.BALANCINGS[bal_name]),
+            key, jnp.asarray(train_mask))
+    xs, ys, ws, edges, xp, y = jax.block_until_ready(cv_prep(*args))
+    tks = jax.device_get(cv_tree_keys(key))
+    c = min(DISPATCH, tks.shape[1])
+    chunk_args = (xs, ys, ws, edges, jnp.asarray(tks[:, :c]))
+    compiled = cv_fit_chunk.lower(*chunk_args).compile()
+    jax.block_until_ready(cv_fit_chunk(*chunk_args))  # warm
+    wall = _steady_s(lambda: cv_fit_chunk(*chunk_args))
+    emit(f"fit_chunk_{c}t_x_{eng.n_folds}f", _cost_flops(compiled), wall,
+         "hist grower level-step program, XLA cost-model FLOPs")
+
+    # --- fused whole-config program --------------------------------------
+    all_args = (*args, jnp.asarray(tem), jnp.asarray(eng.project_ids))
+    compiled = cv_all.lower(*all_args).compile()
+    jax.block_until_ready(cv_all(*all_args))
+    wall = _steady_s(lambda: cv_all(*all_args))
+    emit("fused_config_rf", _cost_flops(compiled), wall,
+         "whole fused config (prep+resample+fit+predict+score)")
+
+    # --- shap explain ------------------------------------------------------
+    from flake16_framework_tpu.ops.trees import fit_forest_hist
+
+    feats, labels, _, _, _ = bench.make_data(N_TESTS)
+    fl, cols, prep, bal, spec = cfg.resolve_config(cfg.SHAP_CONFIGS[0])
+    import numpy as np
+    xq = np.asarray(feats[:N_EXPLAIN, list(cols)], np.float32)
+    yq = np.asarray(labels) == fl
+    forest = jax.block_until_ready(fit_forest_hist(
+        np.asarray(feats[:, list(cols)], np.float32), yq[:N_TESTS],
+        np.ones(N_TESTS, np.float32), jax.random.PRNGKey(1),
+        n_trees=N_TREES, bootstrap=spec.bootstrap,
+        random_splits=spec.random_splits, sqrt_features=spec.sqrt_features,
+        max_depth=48, max_nodes=2 * N_TESTS, tree_chunk=DISPATCH))
+    # XLA formulation: the algorithmic FLOP reference for both impls.
+    # forest_shap_class0 is a host-level driver (it syncs n_nodes for the
+    # slot trim), so cost analysis lowers the inner jitted program
+    # (_xla_forest_shap) on the same trimmed forest the driver would use.
+    m = forest.feature.shape[-1]
+    n_used = int(jax.device_get(jnp.max(forest.n_nodes)))
+    m_trim = min(m, max(128, -(-n_used // 128) * 128))
+    trimmed = (treeshap.trim_nodes(forest, m_trim) if m_trim < m
+               else forest)
+    depth = int(trimmed.max_depth)
+    xla_compiled = treeshap._xla_forest_shap.lower(
+        trimmed, xq, depth=depth).compile()
+    xla_flops = _cost_flops(xla_compiled)
+    xla_fn = lambda: treeshap.forest_shap_class0(forest, xq, impl="xla")
+    jax.block_until_ready(xla_fn())
+    wall_xla = _steady_s(xla_fn)
+    emit(f"shap_xla_{N_EXPLAIN}s_x_{N_TREES}t", xla_flops, wall_xla,
+         "XLA Tree SHAP formulation, XLA cost-model FLOPs")
+    if backend == "tpu":
+        pl = lambda: treeshap.forest_shap_class0(forest, xq, impl="pallas")
+        jax.block_until_ready(pl())
+        wall_pl = _steady_s(pl)
+        emit(f"shap_pallas_{N_EXPLAIN}s_x_{N_TREES}t", xla_flops, wall_pl,
+             "Pallas kernel wall vs the XLA formulation's cost-model "
+             "FLOPs (EFFECTIVE throughput — custom calls are invisible "
+             "to the cost model)")
+
+
 def main():
     steps = sys.argv[1:] or ["fit"]
-    unknown = [s for s in steps if s not in ("fit", "shap")]
+    unknown = [s for s in steps if s not in ("fit", "shap", "mfu")]
     if unknown:
-        sys.exit(f"unknown step(s) {unknown}; known: fit, shap")
+        sys.exit(f"unknown step(s) {unknown}; known: fit, shap, mfu")
     for s in steps:
-        (trace_fit if s == "fit" else trace_shap)()
+        {"fit": trace_fit, "shap": trace_shap, "mfu": trace_mfu}[s]()
 
 
 if __name__ == "__main__":

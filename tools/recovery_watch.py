@@ -316,7 +316,7 @@ def chain():
         if not ok_t and not listener_up():
             return False
     run_stage("trace", [py, os.path.join(REPO, "tools", "hw_trace.py"),
-                        "fit", "shap"], 1800, env_extra=tuned or None)
+                        "fit", "shap", "mfu"], 2400, env_extra=tuned or None)
     # LAST, after every other piece of evidence is banked: the full
     # 216-config grid on the real chip under the tune winners. Its ledger
     # checkpoints after every config and is meta-stamped, so a wedge
