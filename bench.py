@@ -39,7 +39,10 @@ sys.path.insert(0, os.path.join(REPO, "tests"))
 N_TESTS = int(os.environ.get("BENCH_N_TESTS", "2000"))
 N_TREES = int(os.environ.get("BENCH_N_TREES", "100"))
 SEED = 7
-WORKER_TIMEOUT_S = int(os.environ.get("BENCH_WORKER_TIMEOUT_S", "900"))
+# Must cover a COLD tunnel window: ~6 family compiles at ~2 min each over
+# the remote-compile tunnel before the steady passes even start (the
+# persistent .jax_cache makes retries and later windows much cheaper).
+WORKER_TIMEOUT_S = int(os.environ.get("BENCH_WORKER_TIMEOUT_S", "1800"))
 # CPU-fallback sizing: every model family keeps an end-to-end number, with
 # N and ensemble size scaled to what the CPU backend can fit in the budget.
 FB_N_TESTS = int(os.environ.get("BENCH_FB_N_TESTS", "400"))
@@ -62,6 +65,17 @@ def dispatch_env():
 
 
 DISPATCH_TREES, DISPATCH_FOLDS = dispatch_env()
+# SHAP explain tree-chunking: bounded dispatches by default (fault
+# envelope); BENCH_SHAP_TREE_CHUNK=0 explains the whole forest in one
+# dispatch (a tune_shap arm — fewer tunnel round-trips).
+def shap_tree_chunk_env():
+    raw = os.environ.get("BENCH_SHAP_TREE_CHUNK")
+    if raw is None:
+        return DISPATCH_TREES
+    return int(raw) or None
+
+
+SHAP_TREE_CHUNK = shap_tree_chunk_env()
 # Fused single-dispatch mode (default ON): each config (or same-family
 # batch) runs prep+resample+fit+predict+score as ONE device program
 # returning only the [P,3] counts. Round-3 TPU attribution: per-dispatch
@@ -328,7 +342,7 @@ def worker(n_tests, n_trees):
     # tune_shap's xla arm) can ship its winner without a code change.
     n_explain = min(SHAP_EXPLAIN, n_tests)
     shap_kw = dict(tree_overrides=overrides, n_explain=n_explain,
-                   shap_tree_chunk=DISPATCH_TREES,
+                   shap_tree_chunk=SHAP_TREE_CHUNK,
                    fit_dispatch_trees=DISPATCH_TREES,
                    fused_fit=BENCH_FUSED,
                    impl=os.environ.get("BENCH_SHAP_IMPL", "auto"))

@@ -28,11 +28,13 @@ sys.path.insert(0, REPO)
 
 N_TESTS = int(os.environ.get("GRID_N_TESTS", "4000"))
 SEED = 7
-LEDGER = os.path.join(REPO, "_scratch", "grid_fullshape.pkl")
+LEDGER_BASE = os.path.join(REPO, "_scratch", "grid_fullshape")
 RECORD = os.path.join(REPO, "_scratch", "grid_fullshape.json")
 
 
 def main():
+    import hashlib
+
     import jax
 
     import bench
@@ -43,16 +45,35 @@ def main():
     engine = sweep.SweepEngine(feats, labels, projects, names, pids,
                                fused=True)
 
+    # Per-meta ledger (same scheme as grid_tpu.ledger_path): resumes only
+    # runs of the SAME experiment — a GRID_N_TESTS smoke run or a silent
+    # TPU->CPU backend fallback must never merge into the production-shape
+    # record as if its configs were already done.
+    meta = {"n_tests": N_TESTS, "n_trees": 100,
+            "backend": jax.default_backend()}
+    tag = hashlib.sha1(
+        json.dumps(meta, sort_keys=True).encode()).hexdigest()[:10]
+    ledger_file = f"{LEDGER_BASE}_{meta['backend']}_{tag}.pkl"
+
     ledger = {}
-    if os.path.exists(LEDGER):
-        with open(LEDGER, "rb") as fd:
-            ledger = pickle.load(fd)
+    if os.path.exists(ledger_file):
+        with open(ledger_file, "rb") as fd:
+            saved = pickle.load(fd)
+        if saved.get("meta") != meta:
+            raise SystemExit(
+                f"ledger {ledger_file} holds meta {saved.get('meta')} != "
+                f"{meta}; refusing to resume (delete it to restart)")
+        ledger = saved["scores"]
         print(f"resuming: {len(ledger)} configs already done", flush=True)
 
     prev_wall = 0.0
     if os.path.exists(RECORD):
         with open(RECORD) as fd:
-            prev_wall = json.load(fd).get("wall_s", 0.0)
+            prev = json.load(fd)
+        # wall accumulates only across sessions of the SAME experiment
+        if (prev.get("n_tests"), prev.get("backend")) == (
+                N_TESTS, meta["backend"]):
+            prev_wall = prev.get("wall_s", 0.0)
 
     t0 = time.time()
 
@@ -79,9 +100,9 @@ def main():
         print(f"[{i}/{total}] {'/'.join(keys)} ({el:.0f}s, "
               f"rss {resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024} MB)",
               flush=True)
-        with open(LEDGER + ".tmp", "wb") as fd:
-            pickle.dump(live, fd)
-        os.replace(LEDGER + ".tmp", LEDGER)
+        with open(ledger_file + ".tmp", "wb") as fd:
+            pickle.dump({"meta": meta, "scores": live}, fd)
+        os.replace(ledger_file + ".tmp", ledger_file)
         write_record(len(live))
 
     scores = engine.run_grid(ledger=ledger, progress=progress)

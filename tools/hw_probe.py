@@ -229,9 +229,25 @@ for line in predict_ab():
 # deliberately maximize single-dispatch duration (the PROFILE.md wedge
 # pattern), and a fused wedge must not cost the staged rf_full/rf_batch
 # measurements pick_tuned_env needs to decide BENCH_FUSED.
+# rf_exact_chunk is an unproven-on-silicon arm (sort-based grower): it
+# runs AFTER the four-arm hist A/B so its failure cannot cost the
+# measurements pick_tuned_env needs, but before the multi-hour
+# exact_seed_cache watcher stage that commits to the exact tier.
 DEFAULT_STEPS = ["matmul", "prep_pca", "dt", "rf_chunk", "rf_full",
-                 "rf_batch", "rf_fused", "rf_batch_fused", "et_enn", "shap",
-                 "shap_equiv", "predict_ab", "et_full"]
+                 "rf_batch", "rf_fused", "rf_batch_fused", "rf_exact_chunk",
+                 "et_enn", "shap", "shap_equiv", "predict_ab", "et_full"]
+
+# Aliases: a base step re-run under a pinned env, as its own named record.
+# rf_exact_chunk times ONE exact-grower (sort-based, sklearn-semantics)
+# tree-growth chunk at the cache build's clamped dispatch width — the
+# VERDICT r4 decision datum: if the exact tier lands within ~2x of hist
+# per tree on silicon, exact becomes the production ensemble tier and
+# the parity/perf split disappears. (The exact_seed_cache stage also
+# yields per-seed walls; this is the clean steady-state number.)
+STEP_ALIASES = {
+    "rf_exact_chunk": ("rf_chunk", {"F16_ENSEMBLE_GROWER": "exact",
+                                    "BENCH_DISPATCH_TREES": "6"}),
+}
 
 
 # Every step reports the backend jax ACTUALLY initialized — authoritative
@@ -334,6 +350,13 @@ def tune_shap():
             )
             if not ok:
                 return False
+    # Unchunked explain: one dispatch for the whole forest instead of
+    # ceil(T/25) bounded ones — fewer tunnel round-trips IF the single
+    # long dispatch stays inside the fault envelope.
+    ok = run_step("shap", 600, env_extra={"BENCH_SHAP_TREE_CHUNK": "0"},
+                  tag="shap_nochunk")
+    if not ok:
+        return False
     return run_step("shap", 600, env_extra={"BENCH_SHAP_IMPL": "xla"},
                     tag="shap_xla")
 
@@ -341,10 +364,11 @@ def tune_shap():
 def main():
     steps = sys.argv[1:] or DEFAULT_STEPS
     tuners = {"tune_hist": tune_hist, "tune_shap": tune_shap}
-    unknown = [s for s in steps if s not in STEP_SRC and s not in tuners]
+    unknown = [s for s in steps if s not in STEP_SRC and s not in tuners
+               and s not in STEP_ALIASES]
     if unknown:
         sys.exit(f"unknown step(s) {unknown}; known: "
-                 f"{sorted(STEP_SRC) + sorted(tuners)}")
+                 f"{sorted(STEP_SRC) + sorted(tuners) + sorted(STEP_ALIASES)}")
     timeouts = {"matmul": 120, "dt": 420}
     for name in steps:
         if name in tuners:
@@ -352,7 +376,9 @@ def main():
                 print(f"{name} aborted — stopping", file=sys.stderr)
                 break
             continue
-        ok = run_step(name, timeouts.get(name, 600))
+        base, env_extra = STEP_ALIASES.get(name, (name, None))
+        ok = run_step(base, timeouts.get(name, 600), env_extra=env_extra,
+                      tag=name if name != base else None)
         if not ok:
             print(f"step {name} failed — stopping (tunnel state unknown)",
                   file=sys.stderr)

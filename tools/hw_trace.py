@@ -205,18 +205,21 @@ def trace_mfu():
     tks = jax.device_get(cv_tree_keys(key))
     c = min(DISPATCH, tks.shape[1])
     chunk_args = (xs, ys, ws, edges, jnp.asarray(tks[:, :c]))
+    # steady runs go through the SAME AOT executable used for cost
+    # analysis — one compile per program (a second jit-path compile would
+    # add minutes over the remote-compile tunnel on a cold cache)
     compiled = cv_fit_chunk.lower(*chunk_args).compile()
-    jax.block_until_ready(cv_fit_chunk(*chunk_args))  # warm
-    wall = _steady_s(lambda: cv_fit_chunk(*chunk_args))
+    jax.block_until_ready(compiled(*chunk_args))  # warm
+    wall = _steady_s(lambda: compiled(*chunk_args))
     emit(f"fit_chunk_{c}t_x_{eng.n_folds}f", _cost_flops(compiled), wall,
          "hist grower level-step program, XLA cost-model FLOPs")
 
     # --- fused whole-config program --------------------------------------
     all_args = (*args, jnp.asarray(tem), jnp.asarray(eng.project_ids))
-    compiled = cv_all.lower(*all_args).compile()
-    jax.block_until_ready(cv_all(*all_args))
-    wall = _steady_s(lambda: cv_all(*all_args))
-    emit("fused_config_rf", _cost_flops(compiled), wall,
+    compiled_all = cv_all.lower(*all_args).compile()
+    jax.block_until_ready(compiled_all(*all_args))
+    wall = _steady_s(lambda: compiled_all(*all_args))
+    emit("fused_config_rf", _cost_flops(compiled_all), wall,
          "whole fused config (prep+resample+fit+predict+score)")
 
     # --- shap explain ------------------------------------------------------
@@ -246,7 +249,8 @@ def trace_mfu():
     xla_compiled = treeshap._xla_forest_shap.lower(
         trimmed, xq, depth=depth).compile()
     xla_flops = _cost_flops(xla_compiled)
-    xla_fn = lambda: treeshap.forest_shap_class0(forest, xq, impl="xla")
+    xla_fn = lambda: xla_compiled(trimmed, xq)  # same executable as the
+    # cost analysis — no second jit-path compile
     jax.block_until_ready(xla_fn())
     wall_xla = _steady_s(xla_fn)
     emit(f"shap_xla_{N_EXPLAIN}s_x_{N_TREES}t", xla_flops, wall_xla,

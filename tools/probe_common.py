@@ -87,7 +87,8 @@ def shap_times():
     overrides = {"Random Forest": N_TREES, "Extra Trees": N_TREES}
     keys = cfg.SHAP_CONFIGS[0]
     kw = dict(tree_overrides=overrides, n_explain=N_EXPLAIN,
-              shap_tree_chunk=DISPATCH, fit_dispatch_trees=DISPATCH,
+              shap_tree_chunk=bench.SHAP_TREE_CHUNK,
+              fit_dispatch_trees=DISPATCH,
               fused_fit=bench.BENCH_FUSED,
               impl=os.environ.get("BENCH_SHAP_IMPL", "auto"))
     t0 = time.time()
@@ -103,7 +104,8 @@ def shap_times():
     pipeline.shap_for_config(keys, feats, labels, **kw)
     yield f"shap_cfg0_steady_s {time.time() - t0:.2f}"
     if not (os.environ.get("F16_SHAP_SBLK") or os.environ.get("F16_SHAP_LBLK")
-            or os.environ.get("BENCH_SHAP_IMPL")):
+            or os.environ.get("BENCH_SHAP_IMPL")
+            or os.environ.get("BENCH_SHAP_TREE_CHUNK")):
         tm = {}
         pipeline.shap_for_config(keys, feats, labels, timings=tm, **kw)
         yield f"stages {tm}"

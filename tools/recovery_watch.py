@@ -188,15 +188,19 @@ def pick_tuned_env(since_pos):
                             out.split("shap_cfg0_steady_s ", 1)[1].split()[0])
                     except (IndexError, ValueError):
                         continue
-                    if tag == "shap_xla":
-                        consider("shap", steady, env_frag or
-                                 {"BENCH_SHAP_IMPL": "xla"})
-                    else:  # shap_s{SBLK}_l{LBLK}
+                    if env_frag:
+                        # modern records carry the exact knob fragment the
+                        # combo ran under — covers every arm, including
+                        # shap_nochunk, without tag-grammar growth
+                        consider("shap", steady, env_frag)
+                    elif tag == "shap_xla":
+                        consider("shap", steady, {"BENCH_SHAP_IMPL": "xla"})
+                    else:  # legacy shap_s{SBLK}_l{LBLK}
                         try:
                             s, l = tag[len("shap_s"):].split("_l")
                         except ValueError:
                             continue
-                        consider("shap", steady, env_frag or
+                        consider("shap", steady,
                                  {"F16_SHAP_SBLK": s, "F16_SHAP_LBLK": l})
     except OSError:
         return {}
@@ -251,7 +255,7 @@ def chain():
     # PARITY.json — run before any probe/tune stage; the compile cache from
     # prior sessions makes the bench's warmups cheap, and bench has its own
     # probe + CPU-fallback protocol if the device died since matmul.
-    ok_b, out = run_stage("bench", [py, os.path.join(REPO, "bench.py")], 2700)
+    ok_b, out = run_stage("bench", [py, os.path.join(REPO, "bench.py")], 4200)
     persist_bench_json(out, "bench_tpu.json")
     if not ok_b and not listener_up():
         return False
