@@ -76,13 +76,23 @@ def shap_tree_chunk_env():
 
 
 SHAP_TREE_CHUNK = shap_tree_chunk_env()
-# Fused single-dispatch mode (default ON): each config (or same-family
-# batch) runs prep+resample+fit+predict+score as ONE device program
-# returning only the [P,3] counts. Round-3 TPU attribution: per-dispatch
-# tunnel round-trips were the entire 13.18 s/config steady cost while the
-# growth compute measured 0.00 s — fusing collapses them. BENCH_FUSED=0
-# restores the staged path (the T_TRAIN/T_TEST attribution instrument).
-BENCH_FUSED = int(os.environ.get("BENCH_FUSED", "1")) != 0
+# Fused single-dispatch mode: each config (or same-family batch) runs
+# prep+resample+fit+predict+score as ONE device program returning only
+# the [P,3] counts. Round-3 TPU attribution: per-dispatch tunnel
+# round-trips were the entire 13.18 s/config steady cost while the growth
+# compute measured 0.00 s — fusing collapses them. On CPU there is no RTT
+# to amortize and the staged path measured ~10% faster (round-5 A/B), so
+# the default is backend-dependent, resolved inside the worker:
+# BENCH_FUSED=1/0 forces it either way (the tune sweep's knob).
+def bench_fused(backend=None):
+    raw = os.environ.get("BENCH_FUSED")
+    if raw is not None:
+        return int(raw) != 0
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    return backend != "cpu"
 
 # Probe configs (BASELINE.json "configs" №1-3 + family coverage).
 CONFIGS = [
@@ -271,7 +281,7 @@ def make_bench_engine(feats, labels, projects, names, pids, n_trees):
                                tree_overrides=overrides,
                                dispatch_trees=DISPATCH_TREES,
                                dispatch_folds=DISPATCH_FOLDS,
-                               fused=BENCH_FUSED,
+                               fused=bench_fused(),
                                mesh=sweep.default_mesh() if batch_n > 1
                                else None)
     return engine, batch_n
@@ -333,7 +343,7 @@ def worker(n_tests, n_trees):
         "stage": "scores", "t_scores": round(t_scores, 3),
         "t_fit": round(t_fit, 3), "t_predict": round(t_pred, 3),
         "per_config_s": per_config, "n_tests": n_tests, "n_trees": n_trees,
-        "bench_fused": BENCH_FUSED, "bench_batch": batch_n,
+        "bench_fused": engine.fused, "bench_batch": batch_n,
         "dispatch_trees": DISPATCH_TREES, "backend": jax.default_backend(),
     }), flush=True)
 
@@ -344,7 +354,7 @@ def worker(n_tests, n_trees):
     shap_kw = dict(tree_overrides=overrides, n_explain=n_explain,
                    shap_tree_chunk=SHAP_TREE_CHUNK,
                    fit_dispatch_trees=DISPATCH_TREES,
-                   fused_fit=BENCH_FUSED,
+                   fused_fit=engine.fused,
                    impl=os.environ.get("BENCH_SHAP_IMPL", "auto"))
     for keys in cfg.SHAP_CONFIGS:  # warm-up compile per config
         pipeline.shap_for_config(keys, feats, labels, **shap_kw)
@@ -360,7 +370,7 @@ def worker(n_tests, n_trees):
         "per_config_s": per_config,
         "dispatch_trees": DISPATCH_TREES,
         "bench_batch": batch_n,
-        "bench_fused": BENCH_FUSED,
+        "bench_fused": engine.fused,
         "backend": jax.default_backend(),
     }), flush=True)
 

@@ -54,6 +54,12 @@ def main():
     tag = hashlib.sha1(
         json.dumps(meta, sort_keys=True).encode()).hexdigest()[:10]
     ledger_file = f"{LEDGER_BASE}_{meta['backend']}_{tag}.pkl"
+    # The canonical RECORD path is reserved for the production shape on
+    # CPU (the VERDICT r4 item-9 evidence file); any other meta writes a
+    # per-meta record instead of clobbering it.
+    record_file = (RECORD if meta == {"n_tests": 4000, "n_trees": 100,
+                                      "backend": "cpu"}
+                   else f"{LEDGER_BASE}_{meta['backend']}_{tag}.json")
 
     ledger = {}
     if os.path.exists(ledger_file):
@@ -67,8 +73,8 @@ def main():
         print(f"resuming: {len(ledger)} configs already done", flush=True)
 
     prev_wall = 0.0
-    if os.path.exists(RECORD):
-        with open(RECORD) as fd:
+    if os.path.exists(record_file):
+        with open(record_file) as fd:
             prev = json.load(fd)
         # wall accumulates only across sessions of the SAME experiment
         if (prev.get("n_tests"), prev.get("backend")) == (
@@ -90,9 +96,9 @@ def main():
                 resource.RUSAGE_SELF).ru_maxrss // 1024,
             "complete": n_done == 216,
         }
-        with open(RECORD + ".tmp", "w") as fd:
+        with open(record_file + ".tmp", "w") as fd:
             json.dump(rec, fd, indent=1)
-        os.replace(RECORD + ".tmp", RECORD)
+        os.replace(record_file + ".tmp", record_file)
         return rec
 
     def progress(i, total, keys, live):

@@ -229,13 +229,17 @@ for line in predict_ab():
 # deliberately maximize single-dispatch duration (the PROFILE.md wedge
 # pattern), and a fused wedge must not cost the staged rf_full/rf_batch
 # measurements pick_tuned_env needs to decide BENCH_FUSED.
-# rf_exact_chunk is an unproven-on-silicon arm (sort-based grower): it
-# runs AFTER the four-arm hist A/B so its failure cannot cost the
-# measurements pick_tuned_env needs, but before the multi-hour
-# exact_seed_cache watcher stage that commits to the exact tier.
+# rf_exact_chunk is an unproven-on-silicon arm (sort-based grower) whose
+# dispatch is deliberately heavier than the hist arms': it runs with the
+# other wedge-suspects at the END, after every hist measurement
+# pick_tuned_env needs. (In the watcher chain the exact_seed_cache stage
+# runs before the probes and records its own per-seed walls; this step is
+# the clean steady-state datum for the exact-vs-hist tier decision, read
+# by the NEXT session, not an automated gate in this one.)
 DEFAULT_STEPS = ["matmul", "prep_pca", "dt", "rf_chunk", "rf_full",
-                 "rf_batch", "rf_fused", "rf_batch_fused", "rf_exact_chunk",
-                 "et_enn", "shap", "shap_equiv", "predict_ab", "et_full"]
+                 "rf_batch", "rf_fused", "rf_batch_fused",
+                 "et_enn", "shap", "shap_equiv", "predict_ab",
+                 "rf_exact_chunk", "et_full"]
 
 # Aliases: a base step re-run under a pinned env, as its own named record.
 # rf_exact_chunk times ONE exact-grower (sort-based, sklearn-semantics)
@@ -350,15 +354,16 @@ def tune_shap():
             )
             if not ok:
                 return False
-    # Unchunked explain: one dispatch for the whole forest instead of
-    # ceil(T/25) bounded ones — fewer tunnel round-trips IF the single
-    # long dispatch stays inside the fault envelope.
-    ok = run_step("shap", 600, env_extra={"BENCH_SHAP_TREE_CHUNK": "0"},
-                  tag="shap_nochunk")
+    ok = run_step("shap", 600, env_extra={"BENCH_SHAP_IMPL": "xla"},
+                  tag="shap_xla")
     if not ok:
         return False
-    return run_step("shap", 600, env_extra={"BENCH_SHAP_IMPL": "xla"},
-                    tag="shap_xla")
+    # Unchunked explain LAST: one dispatch for the whole forest instead of
+    # ceil(T/25) bounded ones — fewer tunnel round-trips IF the single
+    # long dispatch stays inside the fault envelope. It is the sweep's
+    # wedge-pattern arm, so it must not be able to cost the xla arm.
+    return run_step("shap", 600, env_extra={"BENCH_SHAP_TREE_CHUNK": "0"},
+                    tag="shap_nochunk")
 
 
 def main():

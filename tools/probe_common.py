@@ -89,7 +89,7 @@ def shap_times():
     kw = dict(tree_overrides=overrides, n_explain=N_EXPLAIN,
               shap_tree_chunk=bench.SHAP_TREE_CHUNK,
               fit_dispatch_trees=DISPATCH,
-              fused_fit=bench.BENCH_FUSED,
+              fused_fit=bench.bench_fused(),
               impl=os.environ.get("BENCH_SHAP_IMPL", "auto"))
     t0 = time.time()
     pipeline.shap_for_config(keys, feats, labels, **kw)

@@ -313,8 +313,11 @@ def chain():
     tuned = pick_tuned_env(tune_from)
     if tuned:
         log("tune winners: %s" % json.dumps(tuned))
+        # 4200 like the first bench stage: fresh knob combos can miss the
+        # compile cache, and probe+worker+reprobe+retry at the 1800 s
+        # worker timeout needs ~3900 s worst case.
         ok_t, out = run_stage("bench_tuned",
-                              [py, os.path.join(REPO, "bench.py")], 2700,
+                              [py, os.path.join(REPO, "bench.py")], 4200,
                               env_extra=tuned)
         persist_bench_json(out, "bench_tpu_tuned.json")
         if not ok_t and not listener_up():
