@@ -363,6 +363,12 @@ def worker(n_tests, n_trees):
     for keys in cfg.SHAP_CONFIGS:
         pipeline.shap_for_config(keys, feats, labels, **shap_kw)
     t_shap = time.time() - t0
+    print(json.dumps({
+        "stage": "shap", "t_shap": round(t_shap, 3),
+        "n_tests": n_tests, "n_trees": n_trees, "n_explain": n_explain,
+        "bench_fused": engine.fused,
+        "backend": jax.default_backend(),
+    }), flush=True)
 
     print(json.dumps({
         "t_scores": round(t_scores, 3), "t_shap": round(t_shap, 3),
@@ -409,13 +415,35 @@ def probe():
 STAGE_RECORDS = os.path.join(REPO, "_scratch", "bench_stage_records.jsonl")
 
 
-def _persist_stage(rec):
+def _persist_stage(rec, run_token):
     """Append one completed worker stage to the stage ledger immediately —
-    the crash-safe evidence trail a mid-run tunnel death cannot erase."""
-    rec = dict(rec, ts=time.time())
+    the crash-safe evidence trail a mid-run tunnel death cannot erase.
+    ``run_token`` identifies the worker invocation, so later assembly can
+    only pair stages that ran under the SAME knob configuration."""
+    rec = dict(rec, ts=time.time(), run=run_token)
     os.makedirs(os.path.dirname(STAGE_RECORDS), exist_ok=True)
     with open(STAGE_RECORDS, "a") as fd:
         fd.write(json.dumps(rec) + "\n")
+
+
+def _fresh_stage_records(max_age_s):
+    """Stage records from the shared ledger newer than ``max_age_s``,
+    oldest first (so setdefault keeps the earliest fresh record per
+    stage)."""
+    out = []
+    try:
+        with open(STAGE_RECORDS) as fd:
+            for line in fd:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if time.time() - rec.get("ts", 0) <= max_age_s and \
+                        "stage" in rec:
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
 
 
 def run_worker(n_tests, n_trees, env_extra=None):
@@ -430,6 +458,7 @@ def run_worker(n_tests, n_trees, env_extra=None):
     env = dict(os.environ)
     env.update(env_extra or {})
     stages = {}
+    run_token = f"{os.getpid()}.{int(time.time())}"
     # stderr goes to a FILE (binary: seeking to tell()-400 in text mode can
     # land mid-UTF-8-char and blow up the failure-report path), not a pipe:
     # the worker logs progress there ("warmed ...") and JAX/TPU runtimes
@@ -470,7 +499,7 @@ def run_worker(n_tests, n_trees, env_extra=None):
                 continue
             if isinstance(rec, dict) and "stage" in rec:
                 stages[rec["stage"]] = rec
-                _persist_stage(rec)
+                _persist_stage(rec, run_token)
 
     # Non-blocking raw reads with manual line buffering: readline() on the
     # buffered wrapper can block forever on a partial line (a worker
@@ -607,32 +636,87 @@ def main():
             print(json.dumps(line))
             return
 
+    if result is None and not tpu_stages.get("scores") and \
+            os.environ.get("BENCH_DEVICE") != "cpu":
+        # No live stages — but the recovery watcher's bench stage (a
+        # DIFFERENT process, possibly hours ago in this round's tunnel
+        # window) streams the same stage records to the shared ledger;
+        # a banked on-device scores/shap stage is real evidence this
+        # round and must not be discarded for a CPU fallback. Stages are
+        # grouped by their worker run token so a combined number can only
+        # pair stages measured under ONE knob configuration.
+        runs = {}
+        for rec in _fresh_stage_records(max_age_s=12 * 3600):
+            if rec.get("backend") == "tpu" and (
+                    rec.get("n_tests"), rec.get("n_trees")) == (n, t):
+                runs.setdefault(rec.get("run", "legacy"),
+                                {}).setdefault(rec["stage"], rec)
+        best = None
+        for stages_by_run in runs.values():
+            sc_rec = stages_by_run.get("scores")
+            if sc_rec and (best is None
+                           or sc_rec["ts"] > best["scores"]["ts"]):
+                best = stages_by_run
+        if best:
+            for stage, rec in best.items():
+                tpu_stages.setdefault(stage, rec)
+            detail["stage_source"] = ("watcher-banked stage ledger "
+                                      "(bench_stage_records.jsonl)")
+
     if result is None and tpu_stages.get("scores", {}).get("backend") == \
             "tpu":
-        # The worker banked its scores stage on the device before dying
-        # (mid-SHAP tunnel death): report the PARTIAL on-silicon number
-        # instead of discarding it for a wholesale CPU fallback. The
-        # headline value is the scores-stage speedup alone; the missing
-        # SHAP stage is named in the detail.
+        # The worker (this process's, or the watcher's via the shared
+        # ledger) banked on-device stages before a death: report the
+        # on-silicon number instead of discarding it for a wholesale CPU
+        # fallback. With BOTH stages banked the value is the full
+        # scores+shap speedup; scores alone is reported as partial.
         sc = tpu_stages["scores"]
+        sh = tpu_stages.get("shap")
+        if sh is not None and sh.get("backend") != "tpu":
+            sh = None
         feats, labels, _, _, _ = make_data(n)
         t_base_scores = cpu_scores_baseline(feats, labels, CONFIGS, t)
-        speedup = (round(sum(t_base_scores) / sc["t_scores"], 3)
-                   if sc["t_scores"] else None)  # None, not inf: the
-        # output line must stay strict JSON (json.dumps prints Infinity)
+        scores_speedup = (round(sum(t_base_scores) / sc["t_scores"], 3)
+                          if sc["t_scores"] else None)  # None, not inf:
+        # the output line must stay strict JSON (json.dumps -> Infinity)
         detail.update(
-            n_tests=n, n_trees=t, backend="tpu", partial="shap stage lost "
-            "to a mid-run worker death; value is the scores stage only",
+            n_tests=n, n_trees=t, backend="tpu",
             t_cpu_scores_s=round(sum(t_base_scores), 2),
             t_ours_scores_s=sc["t_scores"],
             per_config_s=sc.get("per_config_s"),
             bench_fused=sc.get("bench_fused"),
             bench_batch=sc.get("bench_batch"),
-            scores_speedup=speedup,
+            scores_speedup=scores_speedup,
         )
+        if sh and sh.get("t_shap") and sc["t_scores"]:
+            t_base_shap, shap_which = cpu_shap_baseline(feats, labels, t)
+            t_ours = sc["t_scores"] + sh["t_shap"]
+            speedup = round(
+                (sum(t_base_scores) + sum(t_base_shap)) / t_ours, 3)
+            detail.update(
+                t_cpu_shap_s=round(sum(t_base_shap), 2),
+                t_ours_shap_s=sh["t_shap"],
+                shap_speedup=round(sum(t_base_shap) / sh["t_shap"], 3),
+                shap_baseline="native C tree_shap" if shap_which == "cext"
+                else "numpy oracle",
+                assembled="scores+shap stages from the stage ledger; the "
+                "combining bench process could not reach the device live",
+                # "source" makes the watcher's persist guard and the
+                # replay selector skip this line: only live full-run
+                # lines may enter the bench_tpu.json freshness cycle
+                source="stage ledger assembly",
+            )
+            metric = tag + "_stages_tpu_speedup"
+        else:
+            detail["partial"] = ("shap stage lost to a mid-run worker "
+                                 "death; value is the scores stage only")
+            # partial lines stay out of the bench_tpu.json replay cycle
+            # too — the stage ledger already preserves their evidence
+            detail["source"] = "partial stage report"
+            speedup = scores_speedup
+            metric = f"scores_probe_{len(CONFIGS)}cfg_n{n}_partial_tpu_speedup"
         print(json.dumps({
-            "metric": f"scores_probe_{len(CONFIGS)}cfg_n{n}"
-                      "_partial_tpu_speedup",
+            "metric": metric,
             "value": speedup if speedup is not None else 0.0,
             "unit": "x_vs_single_host_cpu_stack",
             "vs_baseline": speedup if speedup is not None else 0.0,

@@ -124,3 +124,58 @@ def test_cached_reemission_is_not_reused_or_repersisted(tmp_path, monkeypatch):
     real = dict(replay, value=11.0, detail={"backend": "tpu"})
     recovery_watch.persist_bench_json(json.dumps(real), "bench_tpu.json")
     assert json.loads((scratch / "bench_tpu.json").read_text())["value"] == 11.0
+
+
+def test_stage_ledger_assembly_when_device_unreachable(tmp_path, monkeypatch,
+                                                       capsys):
+    """A tunnel window hours ago banked on-device scores+shap stage records
+    via the shared ledger; the combining bench process (device now dead)
+    must assemble the full on-silicon speedup from them instead of falling
+    back to CPU — and must ignore stale or size-mismatched records."""
+    import importlib.util
+    import time as _time
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod3", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    ledger = tmp_path / "stage_records.jsonl"
+    monkeypatch.setattr(bench, "STAGE_RECORDS", str(ledger))
+    # force the probe down the "no relay listener" fast-fail path
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.delenv("BENCH_DEVICE", raising=False)
+    monkeypatch.setattr(bench, "N_TESTS", 120)
+    monkeypatch.setattr(bench, "N_TREES", 3)
+    monkeypatch.setattr(
+        "flake16_framework_tpu.utils.relay.relay_listener_up",
+        lambda: False, raising=False)
+
+    def put(recs):
+        with open(ledger, "w") as fd:
+            for r in recs:
+                fd.write(json.dumps(r) + "\n")
+
+    now = _time.time()
+    put([
+        # stale record: must be ignored
+        {"stage": "scores", "backend": "tpu", "n_tests": 120, "n_trees": 3,
+         "t_scores": 99.0, "ts": now - 13 * 3600},
+        # wrong size: must be ignored
+        {"stage": "scores", "backend": "tpu", "n_tests": 2000,
+         "n_trees": 100, "t_scores": 88.0, "ts": now},
+        # the real banked window
+        {"stage": "scores", "backend": "tpu", "n_tests": 120, "n_trees": 3,
+         "t_scores": 0.5, "bench_fused": True, "ts": now},
+        {"stage": "shap", "backend": "tpu", "n_tests": 120, "n_trees": 3,
+         "t_shap": 0.25, "ts": now},
+    ])
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"].endswith("_stages_tpu_speedup")
+    d = out["detail"]
+    assert d["backend"] == "tpu"
+    assert d["t_ours_scores_s"] == 0.5 and d["t_ours_shap_s"] == 0.25
+    assert out["value"] > 0
+    assert "assembled" in d
