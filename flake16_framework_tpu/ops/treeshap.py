@@ -264,16 +264,47 @@ def forest_shap_class0(forest, x, *, sample_chunk=None, impl="auto",
             phi.block_until_ready()
             acc = phi if acc is None else acc + phi
         return acc / t_total
-    if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    auto = impl == "auto"
+    if auto:
+        impl = ("pallas" if jax.default_backend() == "tpu"
+                and not _PALLAS_AUTO_BROKEN[0] else "xla")
     depth = int(forest.max_depth)  # static by construction (fit-time bound)
     if impl == "pallas":
         interpret = jax.default_backend() != "tpu"
-        return _pallas_forest_shap(forest, x, depth=depth,
-                                   interpret=interpret)
+        try:
+            # block INSIDE the try: jit dispatch is async, so a device
+            # fault would otherwise surface at the caller's sync, outside
+            # this handler
+            return jax.block_until_ready(_pallas_forest_shap(
+                forest, x, depth=depth, interpret=interpret))
+        except Exception as e:  # Mosaic lowering/runtime errors share no base
+            # auto mode must never cost the SHAP stage a whole bench run
+            # on the kernel's first-ever device attempt: fall back to the
+            # XLA formulation (same values — interpret-mode equality is
+            # test-pinned), remember the failure so chunked calls do not
+            # re-attempt the broken compile per chunk, and say so.
+            # Explicit impl="pallas" still raises — shap_equiv needs the
+            # real error.
+            if not auto:
+                raise
+            import sys
+
+            _PALLAS_AUTO_BROKEN[0] = True
+            print(f"treeshap: pallas kernel failed on "
+                  f"{jax.default_backend()} ({type(e).__name__}: "
+                  f"{str(e)[:200]}); auto-falling back to impl='xla'",
+                  file=sys.stderr, flush=True)
+            impl = "xla"
     if impl != "xla":
         raise ValueError(f"unknown Tree SHAP impl {impl!r}")
     return _xla_forest_shap(forest, x, depth=depth, sample_chunk=sample_chunk)
+
+
+# One sticky flag per process: after an auto-mode kernel failure, every
+# later auto call (including the remaining chunks of a tree_chunk loop)
+# goes straight to the XLA formulation instead of re-running the failed
+# Mosaic compile per chunk.
+_PALLAS_AUTO_BROKEN = [False]
 
 
 @functools.partial(jax.jit, static_argnames=("depth", "sample_chunk"))
@@ -341,47 +372,69 @@ def _shap_kernel(n_leaves_ref, sf, sthr, sratio, sleft, svalid, leaf_p0,
     @pl.when(block_has_leaves)
     def _():
         x_fs = xt[:]                                   # [F, SBLK]
-        iota_f = lax.broadcasted_iota(jnp.int32, (n_features, depth), 0)
+        iota_f = lax.broadcasted_iota(f32, (n_features, depth), 0)
         iota_i = lax.broadcasted_iota(f32, (fp2, 1), 0)
+        # One-hot row selects throughout, NEVER dynamic VMEM indexing:
+        # a traced scalar index (a[leaf, :], w[li, :]) is the classic
+        # construct that passes the Pallas interpreter but trips Mosaic
+        # lowering on real silicon; compares + dots lower unconditionally.
+        iota_lb = lax.broadcasted_iota(f32, (1, _LBLK), 1)
 
         def one_leaf(leaf, acc):
-            sf_l = sf[0, leaf, :]                      # [D] i32
-            onehot_fd = (sf_l[None, :] == iota_f) & (
-                svalid[0, leaf, :][None, :] > 0
-            )
+            onehot_l = (iota_lb == leaf.astype(f32)).astype(f32)  # [1,LBLK]
+
+            def sel_l(ref):
+                """[D] row of one path tensor at ``leaf``: elementwise
+                mask + sublane reduce, NOT a dot — the MXU's default bf16
+                pass would round thresholds/ratios before use (the known
+                TPU matmul-precision trap, trees.py)."""
+                return jnp.sum(ref[0].astype(f32) * onehot_l.T, axis=0)
+
+            sf_l = sel_l(sf)                           # [D] f32 (small ints)
+            svalid_l = sel_l(svalid)
+            onehot_fd = (sf_l[None, :] == iota_f) & (svalid_l[None, :] > 0)
             onehot_fd = onehot_fd.astype(f32)          # [F, D]
 
             # Merged per-feature fractions: z (cover products, via logs),
             # presence, and the per-sample one-fraction o (AND of branch
             # indicators along the path, via a zero count).
-            logr = jnp.log(jnp.maximum(sratio[0, leaf, :], 1e-30))
+            # HIGHEST on every data-carrying dot: the one-hot operand is
+            # bf16-exact but the MXU's default pass would round the DATA
+            # side (logs, x values) before accumulating — the same trap
+            # the tree growers pin (trees.py precision=HIGHEST).
+            hi = lax.Precision.HIGHEST
+            logr = jnp.log(jnp.maximum(sel_l(sratio), 1e-30))
             z = jnp.exp(
                 jnp.dot(onehot_fd, logr[:, None],
-                        preferred_element_type=f32)
+                        preferred_element_type=f32, precision=hi)
             )                                          # [F, 1]
             present = (
                 jnp.dot(onehot_fd, jnp.ones((depth, 1), f32),
-                        preferred_element_type=f32) > 0
+                        preferred_element_type=f32, precision=hi) > 0
             )                                          # [F, 1]
 
             x_sel = jnp.dot(onehot_fd.T, x_fs,
-                            preferred_element_type=f32)  # [D, SBLK]
-            goes_left = x_sel <= sthr[0, leaf, :][:, None]
-            ind = jnp.where(sleft[0, leaf, :][:, None] > 0, goes_left,
+                            preferred_element_type=f32,
+                            precision=hi)              # [D, SBLK]
+            goes_left = x_sel <= sel_l(sthr)[:, None]
+            ind = jnp.where(sel_l(sleft)[:, None] > 0, goes_left,
                             ~goes_left)
             miss = jnp.dot(onehot_fd, 1.0 - ind.astype(f32),
-                           preferred_element_type=f32)
+                           preferred_element_type=f32, precision=hi)
             o = (miss == 0).astype(f32)                # [F, SBLK]
 
             # EXTEND: fold each present feature into the permutation-weight
             # vector w [F+2, SBLK]; path length l is sample-independent.
             w0 = jnp.zeros((fp2, _SBLK), f32).at[0, :].set(1.0)
+            iota_fx = lax.broadcasted_iota(f32, (1, n_features), 1)
 
             def ext(f, carry):
                 w, l = carry
-                pf = present[f, 0]
-                zf = z[f, 0]
-                of = o[f, :][None, :]                  # [1, SBLK]
+                onehot_fx = (iota_fx == f.astype(f32)).astype(f32)  # [1,F]
+                # elementwise mask + reduce (no MXU rounding of z/o)
+                pf = jnp.sum(present.astype(f32) * onehot_fx.T) > 0
+                zf = jnp.sum(z * onehot_fx.T)
+                of = jnp.sum(o * onehot_fx.T, axis=0)[None, :]  # [1, SBLK]
                 stay = zf * w * (l - iota_i) / (l + 1.0)
                 w_shift = jnp.concatenate(
                     [jnp.zeros((1, _SBLK), f32), w[:-1, :]], axis=0
@@ -394,9 +447,9 @@ def _shap_kernel(n_leaves_ref, sf, sthr, sratio, sleft, svalid, leaf_p0,
 
             # UNWIND all features at once, j from high to low; total is the
             # sum of unwound weights, phi_f = (o_f - z_f) * total * leaf_p0.
-            li = (l - 1.0).astype(jnp.int32)
-            nxt0 = jnp.broadcast_to(w[li, :][None, :],
-                                    (n_features, _SBLK))
+            onehot_li = (iota_i == (l - 1.0)).astype(f32)   # [F+2, 1]
+            w_l = jnp.sum(w * onehot_li, axis=0)            # [SBLK]
+            nxt0 = jnp.broadcast_to(w_l[None, :], (n_features, _SBLK))
             zb = jnp.broadcast_to(z, (n_features, _SBLK))
             zb = jnp.maximum(zb, 1e-30)
 
@@ -404,7 +457,9 @@ def _shap_kernel(n_leaves_ref, sf, sthr, sratio, sleft, svalid, leaf_p0,
                 total, nxt = carry
                 j = jnp.float32(fp2 - 2) - jj          # static countdown
                 activ = (j <= l - 2.0)
-                wj = jnp.broadcast_to(w[j.astype(jnp.int32), :][None, :],
+                onehot_j = (iota_i == j).astype(f32)   # [F+2, 1]
+                wj_row = jnp.sum(w * onehot_j, axis=0)  # [SBLK]
+                wj = jnp.broadcast_to(wj_row[None, :],
                                       (n_features, _SBLK))
                 o_safe = jnp.where(o == 0, 1.0, o)
                 tmp = nxt * l / ((j + 1.0) * o_safe)
@@ -422,7 +477,8 @@ def _shap_kernel(n_leaves_ref, sf, sthr, sratio, sleft, svalid, leaf_p0,
                 (jnp.zeros((n_features, _SBLK), f32), nxt0),
             )
 
-            scale = leaf_p0[0, leaf] * leaf_ok[0, leaf]
+            scale = (jnp.sum(leaf_p0[0] * onehot_l[0])
+                     * jnp.sum(leaf_ok[0] * onehot_l[0]))
             contrib = jnp.where(
                 present & (l > 1.0), (o - zb) * total * scale, 0.0
             )

@@ -172,3 +172,45 @@ def test_tree_chunked_shap_matches_unchunked():
     a = np.asarray(forest_shap_class0(forest, xq, impl="xla"))
     b = np.asarray(forest_shap_class0(forest, xq, impl="xla", tree_chunk=3))
     np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-9)
+
+
+def test_auto_mode_falls_back_when_kernel_fails(monkeypatch, capsys):
+    # auto mode must survive a Mosaic failure on the kernel's first device
+    # attempt: fall back to the XLA formulation once, remember the failure
+    # for the rest of the process (chunked calls must not re-attempt the
+    # broken compile per chunk), and never mask an explicit impl="pallas".
+    import numpy as np
+
+    from flake16_framework_tpu.ops import treeshap
+    from flake16_framework_tpu.ops.trees import fit_forest
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(60, 6).astype(np.float32)
+    y = (x[:, 0] > 0)
+    forest = fit_forest(x, y, np.ones(60, np.float32),
+                        jax.random.PRNGKey(0), n_trees=3, bootstrap=True,
+                        random_splits=False, sqrt_features=False,
+                        max_depth=6, max_nodes=128)
+    want = np.asarray(treeshap.forest_shap_class0(forest, x[:10],
+                                                  impl="xla"))
+
+    calls = []
+
+    def boom(*a, **k):
+        calls.append(1)
+        raise RuntimeError("mosaic says no")
+
+    monkeypatch.setattr(treeshap, "_pallas_forest_shap", boom)
+    monkeypatch.setattr(treeshap.jax, "default_backend", lambda: "tpu")
+    treeshap._PALLAS_AUTO_BROKEN[0] = False
+    got = np.asarray(treeshap.forest_shap_class0(forest, x[:10],
+                                                 impl="auto"))
+    np.testing.assert_array_equal(got, want)
+    assert len(calls) == 1 and treeshap._PALLAS_AUTO_BROKEN[0]
+    # second auto call: straight to xla, no new kernel attempt
+    treeshap.forest_shap_class0(forest, x[:10], impl="auto")
+    assert len(calls) == 1
+    # explicit pallas still surfaces the real error
+    with pytest.raises(RuntimeError, match="mosaic"):
+        treeshap.forest_shap_class0(forest, x[:10], impl="pallas")
+    treeshap._PALLAS_AUTO_BROKEN[0] = False
