@@ -36,6 +36,8 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tests"))
 
+from flake16_framework_tpu import obs  # noqa: E402  (needs REPO on sys.path)
+
 N_TESTS = int(os.environ.get("BENCH_N_TESTS", "2000"))
 N_TREES = int(os.environ.get("BENCH_N_TREES", "100"))
 SEED = 7
@@ -297,6 +299,10 @@ def worker(n_tests, n_trees):
     from flake16_framework_tpu import config as cfg, pipeline
     from flake16_framework_tpu.parallel import sweep
 
+    # Telemetry (inherited F16_TELEMETRY): identify this worker's run.
+    obs.manifest_update(verb="bench", n_tests=n_tests, n_trees=n_trees)
+    obs.record_jax_manifest()
+
     feats, labels, projects, names, pids = make_data(n_tests)
     overrides = {"Random Forest": n_trees, "Extra Trees": n_trees}
     engine, batch_n = make_bench_engine(feats, labels, projects, names, pids,
@@ -370,6 +376,7 @@ def worker(n_tests, n_trees):
         "backend": jax.default_backend(),
     }), flush=True)
 
+    obs.emit_memory_gauges()
     print(json.dumps({
         "t_scores": round(t_scores, 3), "t_shap": round(t_shap, 3),
         "t_fit": round(t_fit, 3), "t_predict": round(t_pred, 3),
@@ -419,11 +426,18 @@ def _persist_stage(rec, run_token):
     """Append one completed worker stage to the stage ledger immediately —
     the crash-safe evidence trail a mid-run tunnel death cannot erase.
     ``run_token`` identifies the worker invocation, so later assembly can
-    only pair stages that ran under the SAME knob configuration."""
+    only pair stages that ran under the SAME knob configuration.
+
+    The append goes through the telemetry subsystem's atomic JSONL sink
+    (obs.append_jsonl — O_APPEND + single write) with the SAME on-disk
+    record schema as before, so old tooling (_fresh_stage_records, the
+    watcher) keeps reading it; when F16_TELEMETRY is on the stage is also
+    mirrored into the run's event log as a ``stage`` event."""
     rec = dict(rec, ts=time.time(), run=run_token)
     os.makedirs(os.path.dirname(STAGE_RECORDS), exist_ok=True)
-    with open(STAGE_RECORDS, "a") as fd:
-        fd.write(json.dumps(rec) + "\n")
+    obs.append_jsonl(STAGE_RECORDS, rec)
+    obs.event("stage", **{k: v for k, v in rec.items()
+                          if k not in ("ts", "run")})
 
 
 def _fresh_stage_records(max_age_s):

@@ -9,6 +9,12 @@
     python -m flake16_framework_tpu shap        # TPU Tree SHAP -> shap.pkl
     python -m flake16_framework_tpu figures     # LaTeX artifacts
 
+plus one extension verb the reference lacks:
+
+    python -m flake16_framework_tpu report [RUN_DIR] [--json]
+        # render a telemetry run (F16_TELEMETRY=1 during scores/shap/bench)
+        # into per-stage compile/execute walls, throughput, memory peaks
+
 Unknown/missing verbs raise ValueError like the reference.
 """
 
@@ -69,6 +75,10 @@ def main(argv=None):
         from flake16_framework_tpu.figures.report import write_figures
 
         write_figures()
+    elif command == "report":
+        from flake16_framework_tpu.obs.report import report_main
+
+        report_main(args)
     else:
         raise ValueError("Unrecognized command given")
 

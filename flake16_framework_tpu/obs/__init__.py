@@ -1,0 +1,34 @@
+"""obs — the unified telemetry subsystem (spans, counters/gauges, JSONL
+event sink, run manifests, heartbeat, profiler backend, report verb).
+
+Disabled by default; ``F16_TELEMETRY=1`` (or ``=<root dir>``) turns it on
+for the process (see obs/core.py). Schema in obs/schema.py; rendering in
+obs/report.py (the ``python -m flake16_framework_tpu report`` verb);
+drift lint in tools/check_telemetry_schema.py.
+
+Hot-path contract: every call here is a single ``is None`` check when
+telemetry is off, so instrumentation can live directly in
+pipeline/sweep/bench code without a perf tax.
+"""
+
+from flake16_framework_tpu.obs.core import (  # noqa: F401
+    Span,
+    append_jsonl,
+    configure,
+    counter_add,
+    current_run_dir,
+    default_root,
+    device_memory_peak_mb,
+    emit_memory_gauges,
+    enabled,
+    event,
+    gauge,
+    host_rss_peak_mb,
+    manifest_update,
+    profiler_trace,
+    record_jax_manifest,
+    shutdown,
+    span,
+    start_heartbeat,
+    stop_heartbeat,
+)

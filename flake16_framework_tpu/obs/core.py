@@ -1,0 +1,445 @@
+"""Process-wide telemetry: spans, counters/gauges, JSONL sink, manifest,
+heartbeat.
+
+Disabled by default and ZERO-overhead when off: the env switch is
+``F16_TELEMETRY`` (unset/empty = off; ``1`` = on at the default root
+``_scratch/telemetry`` under the CWD; any other value = the root
+directory). Every public entry point's first action is a single
+``_state is None`` check, and ``span()`` returns one shared no-op object,
+so instrumented hot loops stay within noise of the uninstrumented code
+(test_obs.py pins the disabled-path cost; the bench's per-config walls are
+the production check).
+
+When on, one run = one directory ``<root>/run-<token>/`` holding
+``events.jsonl`` (schema.EVENT_FIELDS; atomic appends — O_APPEND +
+single-write, safe under concurrent threads and processes) and
+``manifest.json`` (schema.MANIFEST_FIELDS; enriched in place as facts
+become known — jax only reports its backend once it is imported and up).
+A daemon heartbeat thread stamps liveness every
+``F16_TELEMETRY_HEARTBEAT_S`` (default 60 s, 0 disables), so a
+multi-hour grid run that dies leaves a diagnosable trail
+(PROFILE.md: the round-5 grid ran 8.3 h with no such trail).
+
+The ``scores profile=DIR`` jax.profiler hook is the ``profiler_trace``
+backend of this same subsystem: it wraps the trace and stamps a
+``profile`` event, telemetry-enabled or not (an explicit profile request
+must not silently depend on F16_TELEMETRY).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from flake16_framework_tpu.obs import schema
+
+_lock = threading.Lock()
+_state = None  # _RunState when enabled; module-level None = the fast path
+_run_seq = 0   # disambiguates same-second reconfigures within one process
+
+
+class _NullSpan:
+    """The shared no-op span (disabled path): one allocation per process."""
+
+    __slots__ = ()
+    wall_s = 0.0
+    cold = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def add(self, **fields):
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _RunState:
+    __slots__ = ("run", "dir", "fd", "t0", "counters", "seen",
+                 "hb_stop", "hb_thread")
+
+    def __init__(self, run, run_dir, fd):
+        self.run = run
+        self.dir = run_dir
+        self.fd = fd
+        self.t0 = time.time()
+        self.counters = {}
+        self.seen = set()  # (span name, key) pairs already timed once
+        self.hb_stop = None
+        self.hb_thread = None
+
+
+# -- sink ---------------------------------------------------------------
+
+
+def append_jsonl(path, obj):
+    """Atomically append one JSON object line to ``path``.
+
+    O_APPEND + a single write(2): concurrent writers (threads or
+    processes) interleave whole lines, never fragments. Shared with
+    bench.py's stage ledger so the crash-evidence record and the
+    telemetry sink cannot diverge on append semantics."""
+    line = (json.dumps(obj) + "\n").encode()
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, line)
+    finally:
+        os.close(fd)
+
+
+def _emit(state, obj):
+    obj.setdefault("ts", round(time.time(), 4))
+    obj.setdefault("run", state.run)
+    line = (json.dumps(obj) + "\n").encode()
+    with _lock:
+        os.write(state.fd, line)
+
+
+# -- lifecycle ----------------------------------------------------------
+
+
+def enabled():
+    return _state is not None
+
+
+def current_run_dir():
+    """The active run directory, or None when telemetry is off."""
+    return _state.dir if _state is not None else None
+
+
+def default_root():
+    raw = os.environ.get("F16_TELEMETRY", "")
+    if raw and raw != "1":
+        return raw
+    return os.path.join(os.getcwd(), "_scratch", "telemetry")
+
+
+def configure(root=None, heartbeat_s=None):
+    """Enable telemetry into ``<root>/run-<token>/`` (idempotent per
+    process: reconfiguring shuts the previous run down first). Called
+    automatically at import when ``F16_TELEMETRY`` is set; tests and
+    drivers may call it explicitly."""
+    global _state, _run_seq
+    shutdown()
+    root = root or default_root()
+    run = time.strftime("%Y%m%d-%H%M%S") + f"-{os.getpid()}"
+    with _lock:
+        _run_seq += 1
+        if _run_seq > 1:  # same second + same pid must not share a dir
+            run += f".{_run_seq}"
+    run_dir = os.path.join(root, f"run-{run}")
+    os.makedirs(run_dir, exist_ok=True)
+    fd = os.open(os.path.join(run_dir, schema.EVENTS_FILE),
+                 os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    _state = _RunState(run, run_dir, fd)
+    _write_manifest_base(_state)
+    if heartbeat_s is None:
+        heartbeat_s = float(os.environ.get("F16_TELEMETRY_HEARTBEAT_S",
+                                           "60") or 0)
+    if heartbeat_s > 0:
+        start_heartbeat(heartbeat_s)
+    return run_dir
+
+
+def shutdown():
+    """Stop the heartbeat, close the sink, return to the disabled state."""
+    global _state
+    state, _state = _state, None
+    if state is None:
+        return
+    stop_heartbeat(state)
+    with _lock:
+        os.close(state.fd)
+
+
+def _maybe_configure_from_env():
+    if os.environ.get("F16_TELEMETRY"):
+        configure()
+
+
+# -- spans --------------------------------------------------------------
+
+
+class Span:
+    """Timed region. ``cold`` is True on the first occurrence of
+    (name, key) in this process — on jitted paths that call carries
+    trace+compile wall, so cold-vs-warm is the compile/execute split the
+    report renders. ``key`` should name the compilation unit (e.g. the
+    model family), not the config: one compile serves all configs of a
+    family."""
+
+    __slots__ = ("_state", "name", "key", "fields", "t0", "wall_s", "cold")
+
+    def __init__(self, state, name, key, fields):
+        self._state = state
+        self.name = name
+        self.key = key
+        self.fields = fields
+        self.wall_s = 0.0
+        self.cold = False
+
+    def add(self, **fields):
+        self.fields.update(fields)
+        return self
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.wall_s = time.time() - self.t0
+        state = self._state
+        seen_key = (self.name, self.key)
+        with _lock:
+            self.cold = seen_key not in state.seen
+            state.seen.add(seen_key)
+        ev = {"kind": "span", "name": self.name,
+              "wall_s": round(self.wall_s, 6), "cold": self.cold}
+        if exc_type is not None:
+            ev["error"] = exc_type.__name__
+        ev.update(self.fields)
+        _emit(state, ev)
+        return False
+
+
+def span(name, key=None, **fields):
+    """``with obs.span("scores.fit", key=family): ...`` — no-op when off."""
+    state = _state
+    if state is None:
+        return _NULL_SPAN
+    return Span(state, name, key, fields)
+
+
+# -- counters and gauges ------------------------------------------------
+
+
+def counter_add(name, inc=1, **fields):
+    """Add to a monotonic counter and emit the post-increment total."""
+    state = _state
+    if state is None:
+        return
+    with _lock:
+        total = state.counters.get(name, 0) + inc
+        state.counters[name] = total
+    _emit(state, {"kind": "counter", "name": name, "inc": inc,
+                  "total": total, **fields})
+
+
+def gauge(name, value, **fields):
+    state = _state
+    if state is None or value is None:
+        return
+    _emit(state, {"kind": "gauge", "name": name,
+                  "value": round(float(value), 4), **fields})
+
+
+def event(kind, **fields):
+    """Emit a raw event of a schema-known kind (bench stage mirroring)."""
+    state = _state
+    if state is None:
+        return
+    _emit(state, {"kind": kind, **fields})
+
+
+def host_rss_peak_mb():
+    import resource
+
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+
+
+def device_memory_peak_mb():
+    """Peak device memory over local devices via ``device.memory_stats()``,
+    None where the backend doesn't report it (CPU). Never imports jax
+    itself — telemetry must not initialize a backend."""
+    jaxmod = sys.modules.get("jax")
+    if jaxmod is None:
+        return None
+    peak = None
+    try:
+        for d in jaxmod.devices():
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            b = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+            if b is not None:
+                peak = max(peak or 0, b)
+    except Exception:
+        return None
+    return None if peak is None else peak / 1e6
+
+
+def emit_memory_gauges():
+    """Stamp the standard memory gauges (host RSS peak; device peak where
+    the backend reports one)."""
+    if _state is None:
+        return
+    gauge("host_rss_peak_mb", host_rss_peak_mb())
+    gauge("device_mem_peak_mb", device_memory_peak_mb())
+
+
+# -- manifest -----------------------------------------------------------
+
+
+def _git_sha():
+    try:
+        repo = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        r = subprocess.run(["git", "rev-parse", "HEAD"], cwd=repo,
+                           capture_output=True, text=True, timeout=10)
+        return r.stdout.strip() or None
+    except Exception:
+        return None
+
+
+def _env_fingerprint():
+    prefixes = ("F16_", "BENCH_", "GRID_", "JAX_", "XLA_")
+    return {k: v for k, v in sorted(os.environ.items())
+            if k.startswith(prefixes)}
+
+
+def _write_manifest_base(state):
+    manifest = {
+        "schema": schema.MANIFEST_SCHEMA,
+        "run": state.run,
+        "started_ts": round(state.t0, 4),
+        "argv": list(sys.argv),
+        "python": sys.version.split()[0],
+        "hostname": os.uname().nodename,
+        "pid": os.getpid(),
+        "git_sha": _git_sha(),
+        "env": _env_fingerprint(),
+    }
+    _dump_manifest(state, manifest)
+
+
+def _dump_manifest(state, manifest):
+    path = os.path.join(state.dir, schema.MANIFEST_FILE)
+    with open(path + ".tmp", "w") as fd:
+        json.dump(manifest, fd, indent=1, default=str)
+    os.replace(path + ".tmp", path)
+
+
+def manifest_update(**fields):
+    """Merge facts into manifest.json (atomic read-modify-replace)."""
+    state = _state
+    if state is None:
+        return
+    path = os.path.join(state.dir, schema.MANIFEST_FILE)
+    with _lock:
+        try:
+            with open(path) as fd:
+                manifest = json.load(fd)
+        except (OSError, ValueError):
+            manifest = {"schema": schema.MANIFEST_SCHEMA, "run": state.run,
+                        "started_ts": round(state.t0, 4),
+                        "argv": list(sys.argv),
+                        "python": sys.version.split()[0],
+                        "env": _env_fingerprint()}
+        manifest.update(fields)
+        _dump_manifest(state, manifest)
+
+
+def record_jax_manifest(mesh=None):
+    """Enrich the manifest with the device facts only jax knows — version,
+    backend, device kind/count, mesh shape. Cheap no-op when off; safe to
+    call before/without jax (fields are simply absent)."""
+    if _state is None:
+        return
+    jaxmod = sys.modules.get("jax")
+    if jaxmod is None:
+        return
+    try:
+        devices = jaxmod.devices()
+        fields = {
+            "jax_version": jaxmod.__version__,
+            "backend": jaxmod.default_backend(),
+            "device_kind": devices[0].device_kind if devices else None,
+            "device_count": len(devices),
+        }
+    except Exception:
+        return
+    if mesh is not None:
+        fields["mesh_shape"] = {str(k): int(v)
+                                for k, v in dict(mesh.shape).items()}
+    manifest_update(**fields)
+
+
+# -- heartbeat ----------------------------------------------------------
+
+
+def start_heartbeat(interval_s=60.0):
+    """Start (or restart) the liveness thread: one ``heartbeat`` event per
+    interval with uptime, peak RSS, device memory, and the counter
+    snapshot. Daemon — never blocks process exit."""
+    state = _state
+    if state is None:
+        return
+    stop_heartbeat(state)
+    stop = threading.Event()
+
+    def beat():
+        while not stop.wait(interval_s):
+            st = _state
+            if st is not state:
+                return
+            with _lock:
+                counters = dict(state.counters)
+            ev = {"kind": "heartbeat",
+                  "uptime_s": round(time.time() - state.t0, 1),
+                  "rss_mb": host_rss_peak_mb(), "counters": counters}
+            dev = device_memory_peak_mb()
+            if dev is not None:
+                ev["device_mem_mb"] = round(dev, 1)
+            _emit(state, ev)
+
+    t = threading.Thread(target=beat, name="f16-telemetry-heartbeat",
+                         daemon=True)
+    state.hb_stop, state.hb_thread = stop, t
+    t.start()
+
+
+def stop_heartbeat(state=None):
+    state = state if state is not None else _state
+    if state is None or state.hb_stop is None:
+        return
+    state.hb_stop.set()
+    state.hb_thread.join(timeout=5)
+    state.hb_stop = state.hb_thread = None
+
+
+# -- profiler backend ---------------------------------------------------
+
+
+class profiler_trace:
+    """Context manager: ``jax.profiler.trace(trace_dir)`` + a ``profile``
+    event. ``trace_dir=None`` is a no-op — callers pass their optional
+    profile knob straight through. Works with telemetry off (an explicit
+    profile request stands on its own); the event is emitted only when the
+    sink is up."""
+
+    def __init__(self, trace_dir):
+        self.trace_dir = trace_dir
+        self._cm = None
+
+    def __enter__(self):
+        if self.trace_dir is not None:
+            import jax
+
+            self._cm = jax.profiler.trace(self.trace_dir)
+            self._cm.__enter__()
+            event("profile", trace_dir=str(self.trace_dir))
+        return self
+
+    def __exit__(self, *exc):
+        if self._cm is not None:
+            return self._cm.__exit__(*exc)
+        return False
+
+
+_maybe_configure_from_env()

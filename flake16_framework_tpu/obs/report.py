@@ -1,0 +1,225 @@
+"""The ``report`` CLI verb: render one telemetry run's events.jsonl +
+manifest.json into a human summary (or ``--json`` for CI).
+
+    python -m flake16_framework_tpu report [RUN_DIR] [--json] [--root DIR]
+
+With no RUN_DIR the latest run under the telemetry root is used (the root
+is ``F16_TELEMETRY`` when it names a directory, else
+``_scratch/telemetry`` — obs.core.default_root). This replaces
+hand-reading ``_scratch/*.jsonl`` after a grid/bench/scores session
+(PROFILE.md "Telemetry").
+
+The compile/execute split: a span's first (name, key) occurrence is
+``cold`` — on jitted paths it carries trace+compile. Per span name the
+estimated compile wall is ``cold_total - cold_n * warm_mean`` (clamped at
+0; the whole cold total when no warm call exists to calibrate against),
+and execute wall is the remainder of the total.
+"""
+
+import json
+import os
+import sys
+
+from flake16_framework_tpu.obs import core, schema
+
+
+def find_run_dir(path=None, root=None):
+    """Resolve a run directory: an explicit run dir (has events.jsonl), an
+    explicit root (newest run-* child), or the default root."""
+    if path is not None:
+        if os.path.isfile(os.path.join(path, schema.EVENTS_FILE)):
+            return path
+        root = path
+    root = root or core.default_root()
+    runs = sorted(
+        (d for d in (os.path.join(root, n) for n in
+                     (os.listdir(root) if os.path.isdir(root) else ()))
+         if os.path.isfile(os.path.join(d, schema.EVENTS_FILE))),
+        key=os.path.getmtime,
+    )
+    if not runs:
+        raise SystemExit(
+            f"no telemetry runs under {root!r} — run a verb with "
+            "F16_TELEMETRY=1 first (see PROFILE.md 'Telemetry')")
+    return runs[-1]
+
+
+def load_run(run_dir):
+    """(manifest dict or {}, events list) — malformed lines are skipped
+    (a crashed writer's torn final line must not kill the report)."""
+    manifest = {}
+    try:
+        with open(os.path.join(run_dir, schema.MANIFEST_FILE)) as fd:
+            manifest = json.load(fd)
+    except (OSError, ValueError):
+        pass
+    events = []
+    with open(os.path.join(run_dir, schema.EVENTS_FILE)) as fd:
+        for line in fd:
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(ev, dict):
+                events.append(ev)
+    return manifest, events
+
+
+def summarize(manifest, events):
+    """The report object (schema.REPORT_FIELDS) from one run's documents."""
+    spans = {}
+    counters = {}
+    gauges = {}
+    heartbeats = {"n": 0, "last_ts": None}
+    ts_all = [e["ts"] for e in events if isinstance(e.get("ts"), (int, float))]
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "span" and isinstance(ev.get("wall_s"), (int, float)):
+            st = spans.setdefault(ev.get("name", "?"), {
+                "n": 0, "cold_n": 0, "cold_s": 0.0, "warm_s": 0.0})
+            st["n"] += 1
+            if ev.get("cold"):
+                st["cold_n"] += 1
+                st["cold_s"] += ev["wall_s"]
+            else:
+                st["warm_s"] += ev["wall_s"]
+        elif kind == "counter":
+            counters[ev.get("name", "?")] = ev.get("total", 0)
+        elif kind == "gauge" and isinstance(ev.get("value"), (int, float)):
+            st = gauges.setdefault(ev.get("name", "?"),
+                                   {"peak": ev["value"]})
+            st["peak"] = max(st["peak"], ev["value"])
+            st["last"] = ev["value"]
+        elif kind == "heartbeat":
+            heartbeats["n"] += 1
+            heartbeats["last_ts"] = ev.get("ts")
+
+    started = manifest.get("started_ts")
+    t0 = started if isinstance(started, (int, float)) else (
+        min(ts_all) if ts_all else 0.0)
+    wall_s = round(max(ts_all) - t0, 3) if ts_all else 0.0
+
+    for st in spans.values():
+        warm_n = st["n"] - st["cold_n"]
+        warm_mean = st["warm_s"] / warm_n if warm_n else None
+        if warm_mean is not None:
+            compile_est = max(0.0, st["cold_s"] - st["cold_n"] * warm_mean)
+        else:
+            compile_est = st["cold_s"]  # no warm call to calibrate against
+        total = st["cold_s"] + st["warm_s"]
+        st.update(
+            total_s=round(total, 3), cold_s=round(st["cold_s"], 3),
+            warm_s=round(st["warm_s"], 3),
+            warm_mean_s=round(warm_mean, 4) if warm_mean is not None
+            else None,
+            compile_est_s=round(compile_est, 3),
+            execute_s=round(total - compile_est, 3),
+        )
+
+    throughput = {
+        name: round(total / wall_s, 3)
+        for name, total in counters.items()
+        if wall_s > 0 and isinstance(total, (int, float))
+    }
+    return {
+        "schema": schema.REPORT_SCHEMA,
+        "run": manifest.get("run", "?"),
+        "wall_s": wall_s,
+        "manifest": manifest,
+        "spans": spans,
+        "counters": counters,
+        "throughput_per_s": throughput,
+        "gauges": gauges,
+        "heartbeats": heartbeats,
+        "n_events": len(events),
+    }
+
+
+def render(report):
+    """Human-readable summary of a summarize() object."""
+    m = report["manifest"]
+    out = []
+    ident = [f"run {report['run']}"]
+    for field in ("backend", "device_kind", "device_count", "jax_version",
+                  "python"):
+        if m.get(field) is not None:
+            ident.append(f"{field}={m[field]}")
+    if m.get("mesh_shape"):
+        ident.append("mesh=" + "x".join(
+            f"{k}:{v}" for k, v in m["mesh_shape"].items()))
+    if m.get("git_sha"):
+        ident.append(f"git={str(m['git_sha'])[:10]}")
+    out.append("  ".join(ident))
+    out.append(f"wall {report['wall_s']:.1f}s over {report['n_events']} "
+               "events")
+    out.append("")
+
+    if report["spans"]:
+        hdr = (f"{'span':<28}{'n':>5}{'cold':>6}{'compile_s':>11}"
+               f"{'execute_s':>11}{'warm_mean_s':>13}")
+        out += [hdr, "-" * len(hdr)]
+        for name in sorted(report["spans"]):
+            st = report["spans"][name]
+            wm = st["warm_mean_s"]
+            out.append(
+                f"{name:<28}{st['n']:>5}{st['cold_n']:>6}"
+                f"{st['compile_est_s']:>11.3f}{st['execute_s']:>11.3f}"
+                f"{wm:>13.4f}" if wm is not None else
+                f"{name:<28}{st['n']:>5}{st['cold_n']:>6}"
+                f"{st['compile_est_s']:>11.3f}{st['execute_s']:>11.3f}"
+                f"{'-':>13}")
+        out.append("")
+
+    if report["counters"]:
+        hdr = f"{'counter':<28}{'total':>10}{'per_s':>10}"
+        out += [hdr, "-" * len(hdr)]
+        for name in sorted(report["counters"]):
+            per_s = report["throughput_per_s"].get(name)
+            out.append(
+                f"{name:<28}{report['counters'][name]:>10}"
+                + (f"{per_s:>10.3f}" if per_s is not None else f"{'-':>10}"))
+        out.append("")
+
+    if report["gauges"]:
+        hdr = f"{'gauge':<28}{'peak':>12}{'last':>12}"
+        out += [hdr, "-" * len(hdr)]
+        for name in sorted(report["gauges"]):
+            g = report["gauges"][name]
+            out.append(f"{name:<28}{g['peak']:>12.1f}"
+                       f"{g.get('last', g['peak']):>12.1f}")
+        out.append("")
+
+    hb = report["heartbeats"]
+    if hb["n"]:
+        out.append(f"heartbeats: {hb['n']} (last at ts {hb['last_ts']})")
+    return "\n".join(out)
+
+
+def report_main(args, out=None):
+    """CLI entry for the ``report`` verb (``__main__.py``)."""
+    out = out or sys.stdout
+    as_json = False
+    root = None
+    path = None
+    it = iter(args)
+    for a in it:
+        if a == "--json":
+            as_json = True
+        elif a == "--root":
+            root = next(it, None)
+            if root is None:
+                raise ValueError("--root needs a directory argument")
+        elif a.startswith("--"):
+            raise ValueError(f"Unrecognized report option {a!r}")
+        elif path is None:
+            path = a
+        else:
+            raise ValueError(f"Unrecognized report argument {a!r}")
+    run_dir = find_run_dir(path, root)
+    manifest, events = load_run(run_dir)
+    report = summarize(manifest, events)
+    if as_json:
+        out.write(json.dumps(report, indent=1, default=str) + "\n")
+    else:
+        out.write(f"[{run_dir}]\n" + render(report) + "\n")
+    return report

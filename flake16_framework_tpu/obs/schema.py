@@ -1,0 +1,117 @@
+"""The telemetry wire schema — the single source of truth the emitter
+(obs/core.py), the renderer (obs/report.py), and the drift lint
+(tools/check_telemetry_schema.py) all import.
+
+Three documents exist on disk per run (PROFILE.md "Telemetry"):
+
+- ``events.jsonl`` — one JSON object per line, ``kind`` in EVENT_FIELDS.
+  Every event carries ``ts`` (unix seconds) and ``run`` (the run token).
+- ``manifest.json`` — one object identifying the run (schema
+  MANIFEST_SCHEMA): run token, start time, argv, python, env fingerprint,
+  and — once jax is up — jax version/backend/device kind/mesh shape.
+- ``report --json`` output — schema REPORT_SCHEMA, derived from the two
+  above by obs/report.summarize.
+
+Validation is permissive on EXTRA fields (events may carry arbitrary
+context like config keys) and strict on required fields and their types:
+schema drift = an emitter inventing a kind, dropping a required field, or
+changing a type — exactly what the lint turns into a tier-1 failure.
+"""
+
+EVENTS_FILE = "events.jsonl"
+MANIFEST_FILE = "manifest.json"
+
+TELEMETRY_SCHEMA = "flake16-telemetry-v1"
+MANIFEST_SCHEMA = "flake16-run-manifest-v1"
+REPORT_SCHEMA = "flake16-report-v1"
+
+_NUM = (int, float)
+
+# kind -> {field: allowed types}; every event also carries the COMMON set.
+COMMON_FIELDS = {"kind": str, "ts": _NUM, "run": str}
+EVENT_FIELDS = {
+    # A timed region. ``cold`` marks the first occurrence of this span's
+    # (name, key) in the process — on jitted paths that call includes
+    # trace+compile, so the report can split compile from execute wall.
+    "span": {"name": str, "wall_s": _NUM, "cold": bool},
+    # Monotonic totals (configs, folds, trees, ...): inc and post-inc total.
+    "counter": {"name": str, "inc": _NUM, "total": _NUM},
+    # Point-in-time measurements (peak RSS, device memory, ...).
+    "gauge": {"name": str, "value": _NUM},
+    # Liveness trail for multi-hour runs; a dead run's last heartbeat
+    # timestamps where it died.
+    "heartbeat": {"uptime_s": _NUM, "rss_mb": _NUM},
+    # A jax.profiler.trace capture started (the `scores profile=DIR` hook).
+    "profile": {"trace_dir": str},
+    # Mirror of a bench stage record (bench.py stage ledger schema).
+    "stage": {"stage": str},
+}
+
+MANIFEST_FIELDS = {
+    "schema": str, "run": str, "started_ts": _NUM, "argv": list,
+    "python": str, "env": dict,
+}
+
+REPORT_FIELDS = {
+    "schema": str, "run": str, "wall_s": _NUM, "spans": dict,
+    "counters": dict, "gauges": dict,
+}
+
+# Required numeric per-span stats in a report's ``spans`` values — what the
+# acceptance criterion calls "per-stage compile/execute walls".
+REPORT_SPAN_FIELDS = {"n", "cold_n", "total_s", "compile_est_s", "execute_s"}
+
+
+def _check_fields(obj, fields, problems, ctx):
+    for name, types in fields.items():
+        if name not in obj:
+            problems.append(f"{ctx}: missing required field {name!r}")
+        elif not isinstance(obj[name], types):
+            problems.append(
+                f"{ctx}: field {name!r} has type "
+                f"{type(obj[name]).__name__}, want {types}")
+
+
+def validate_event(obj):
+    """Problems with one events.jsonl object (empty list = valid)."""
+    problems = []
+    if not isinstance(obj, dict):
+        return [f"event is {type(obj).__name__}, want object"]
+    kind = obj.get("kind")
+    if kind not in EVENT_FIELDS:
+        return [f"unknown event kind {kind!r} "
+                f"(known: {sorted(EVENT_FIELDS)})"]
+    ctx = f"event kind={kind}"
+    _check_fields(obj, COMMON_FIELDS, problems, ctx)
+    _check_fields(obj, EVENT_FIELDS[kind], problems, ctx)
+    return problems
+
+
+def validate_manifest(obj):
+    problems = []
+    if not isinstance(obj, dict):
+        return [f"manifest is {type(obj).__name__}, want object"]
+    _check_fields(obj, MANIFEST_FIELDS, problems, "manifest")
+    if obj.get("schema") not in (None, MANIFEST_SCHEMA):
+        problems.append(
+            f"manifest: schema {obj.get('schema')!r} != {MANIFEST_SCHEMA!r}")
+    return problems
+
+
+def validate_report(obj):
+    problems = []
+    if not isinstance(obj, dict):
+        return [f"report is {type(obj).__name__}, want object"]
+    _check_fields(obj, REPORT_FIELDS, problems, "report")
+    if obj.get("schema") != REPORT_SCHEMA:
+        problems.append(
+            f"report: schema {obj.get('schema')!r} != {REPORT_SCHEMA!r}")
+    for name, stats in (obj.get("spans") or {}).items():
+        if not isinstance(stats, dict):
+            problems.append(f"report: spans[{name!r}] is not an object")
+            continue
+        missing = REPORT_SPAN_FIELDS - set(stats)
+        if missing:
+            problems.append(
+                f"report: spans[{name!r}] missing {sorted(missing)}")
+    return problems

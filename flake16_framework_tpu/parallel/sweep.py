@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from flake16_framework_tpu import config as cfg
+from flake16_framework_tpu import config as cfg, obs
 from flake16_framework_tpu.ops.metrics import confusion_by_project, format_scores
 from flake16_framework_tpu.ops.preprocess import fit_preprocess, transform
 from flake16_framework_tpu.ops.resample import resample
@@ -281,8 +281,17 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
     # lax.switch; jax 0.9's varying-manual-axes validator rejects
     # that conservatively (its own error message says to disable).
     def smap(f, in_specs, out_specs):
-        return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                                     out_specs=out_specs, check_vma=False))
+        try:
+            sm = jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_vma=False)
+        except AttributeError:
+            # jax < 0.6 ships shard_map under experimental, with the
+            # validator knob spelled check_rep instead of check_vma.
+            from jax.experimental.shard_map import shard_map as shard_map_fn
+
+            sm = shard_map_fn(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+        return jax.jit(sm)
 
     fit_b = smap(fit_batch, (P(), P(), pspec, pspec, pspec, pspec, pspec),
                  (forest_specs, pspec, pspec))
@@ -557,52 +566,69 @@ class SweepEngine:
         n_trees = self._spec(model_name).n_trees
         dc, df = self._dispatch_bounds(n_trees)
 
+        family = (fs_name, model_name)
         if self.fused and timings is None:
-            t0 = time.time()
-            counts = np.asarray(cv_all(  # np.asarray blocks on the result
-                *fit_args, jnp.asarray(test_mask),
-                jnp.asarray(self.project_ids),
-            ))
-            wall = time.time() - t0
+            with obs.span("scores.config", key=(*family, "fused"),
+                          mode="fused", config="/".join(config_keys)):
+                t0 = time.time()
+                counts = np.asarray(cv_all(  # np.asarray blocks on the result
+                    *fit_args, jnp.asarray(test_mask),
+                    jnp.asarray(self.project_ids),
+                ))
+                wall = time.time() - t0
             self.fused_configs.add(tuple(config_keys))
+            self._count_done(1, n_trees)
             scores, scores_total = format_scores(
                 counts, self.project_names, self.projects
             )
             return [wall / self.n_folds, 0.0, scores, scores_total]
 
-        t0 = time.time()
-        if dc is not None or df is not None:
-            forest, xp, y = _chunked_fit(
-                cv_prep, cv_fit_chunk, lambda: cv_tree_keys(key), fit_args,
-                n_trees, dc, tree_axis=1, fold_chunk=df, timings=timings,
-            )
-        else:
-            forest, xp, y = cv_fit(*fit_args)
-            jax.block_until_ready(forest)
-        t_train = time.time() - t0
+        with obs.span("scores.fit", key=(*family, "staged"),
+                      config="/".join(config_keys)):
+            t0 = time.time()
+            if dc is not None or df is not None:
+                forest, xp, y = _chunked_fit(
+                    cv_prep, cv_fit_chunk, lambda: cv_tree_keys(key),
+                    fit_args, n_trees, dc, tree_axis=1, fold_chunk=df,
+                    timings=timings,
+                )
+            else:
+                forest, xp, y = cv_fit(*fit_args)
+                jax.block_until_ready(forest)
+            t_train = time.time() - t0
         if timings is not None:
             timings["fit_total_s"] = round(t_train, 4)
 
-        t0 = time.time()
-        counts = cv_score(
-            forest, xp, y, jnp.asarray(test_mask),
-            jnp.asarray(self.project_ids),
-        )
-        if timings is not None:
-            jax.block_until_ready(counts)
-            timings["score_s"] = round(time.time() - t0, 4)
-            t1 = time.time()
-            counts = np.asarray(counts)
-            timings["counts_to_host_s"] = round(time.time() - t1, 4)
-        else:
-            counts = np.asarray(counts)
-        t_test = time.time() - t0
+        with obs.span("scores.score", key=(*family, "staged"),
+                      config="/".join(config_keys)):
+            t0 = time.time()
+            counts = cv_score(
+                forest, xp, y, jnp.asarray(test_mask),
+                jnp.asarray(self.project_ids),
+            )
+            if timings is not None:
+                jax.block_until_ready(counts)
+                timings["score_s"] = round(time.time() - t0, 4)
+                t1 = time.time()
+                counts = np.asarray(counts)
+                timings["counts_to_host_s"] = round(time.time() - t1, 4)
+            else:
+                counts = np.asarray(counts)
+            t_test = time.time() - t0
+        self._count_done(1, n_trees)
 
         scores, scores_total = format_scores(
             counts, self.project_names, self.projects
         )
         return [t_train / self.n_folds, t_test / self.n_folds, scores,
                 scores_total]
+
+    def _count_done(self, n_configs, n_trees):
+        """Throughput counters after a config (or batch) completes —
+        no-ops when telemetry is off."""
+        obs.counter_add("configs", n_configs)
+        obs.counter_add("folds", n_configs * self.n_folds)
+        obs.counter_add("trees", n_configs * self.n_folds * n_trees)
 
     def _get_sharded_fns(self, fs_name, model_name):
         key = (fs_name, model_name)
@@ -664,12 +690,16 @@ class SweepEngine:
         n_trees = self._spec(model_name).n_trees
         dc, df = self._dispatch_bounds(n_trees)
 
+        family = (fs_name, model_name)
         if self.fused:
-            t0 = time.time()
-            counts = np.asarray(all_b(
-                *fit_args, jnp.asarray(tems), jnp.asarray(self.project_ids),
-            ))
-            wall = (time.time() - t0) / len(config_batch)
+            with obs.span("scores.config_batch", key=(*family, "fused", b),
+                          mode="fused", batch=len(config_batch)):
+                t0 = time.time()
+                counts = np.asarray(all_b(
+                    *fit_args, jnp.asarray(tems),
+                    jnp.asarray(self.project_ids),
+                ))
+                wall = (time.time() - t0) / len(config_batch)
             out = []
             for i in range(len(config_batch)):
                 scores, scores_total = format_scores(
@@ -678,30 +708,37 @@ class SweepEngine:
                 out.append([wall / self.n_folds, 0.0, scores, scores_total])
             self.fused_configs.update(tuple(k) for k in config_batch)
             self.amortized_configs.update(tuple(k) for k in config_batch)
+            self._count_done(len(config_batch), n_trees)
             return out
 
-        t0 = time.time()
-        if dc is not None or df is not None:
-            # Same dispatch-bounding as run_config, but SPMD over the mesh:
-            # every chunk dispatch is one shard_map program.
-            forest, xp, y = _chunked_fit(
-                prep_b, fit_chunk_b, lambda: tree_keys_b(jnp.asarray(keys)),
-                fit_args, n_trees, dc, tree_axis=2, fold_chunk=df,
-            )
-        else:
-            forest, xp, y = fit_b(*fit_args)
-            jax.block_until_ready(forest)
-        # Attribute over the REAL configs, not the padded batch: padding
-        # duplicates are wasted work the real configs bear, and dividing by
-        # the padded size under-counts per-config time whenever the mesh has
-        # more devices than the batch has configs.
-        t_train = (time.time() - t0) / len(config_batch)
+        with obs.span("scores.fit_batch", key=(*family, "staged", b),
+                      batch=len(config_batch)):
+            t0 = time.time()
+            if dc is not None or df is not None:
+                # Same dispatch-bounding as run_config, but SPMD over the
+                # mesh: every chunk dispatch is one shard_map program.
+                forest, xp, y = _chunked_fit(
+                    prep_b, fit_chunk_b,
+                    lambda: tree_keys_b(jnp.asarray(keys)),
+                    fit_args, n_trees, dc, tree_axis=2, fold_chunk=df,
+                )
+            else:
+                forest, xp, y = fit_b(*fit_args)
+                jax.block_until_ready(forest)
+            # Attribute over the REAL configs, not the padded batch: padding
+            # duplicates are wasted work the real configs bear, and dividing
+            # by the padded size under-counts per-config time whenever the
+            # mesh has more devices than the batch has configs.
+            t_train = (time.time() - t0) / len(config_batch)
 
-        t0 = time.time()
-        counts = score_b(forest, xp, y, jnp.asarray(tems),
-                         jnp.asarray(self.project_ids))
-        counts = np.asarray(counts)
-        t_test = (time.time() - t0) / len(config_batch)
+        with obs.span("scores.score_batch", key=(*family, "staged", b),
+                      batch=len(config_batch)):
+            t0 = time.time()
+            counts = score_b(forest, xp, y, jnp.asarray(tems),
+                             jnp.asarray(self.project_ids))
+            counts = np.asarray(counts)
+            t_test = (time.time() - t0) / len(config_batch)
+        self._count_done(len(config_batch), n_trees)
 
         out = []
         for i in range(len(config_batch)):
@@ -728,6 +765,7 @@ class SweepEngine:
         single chip a width >1 still batches configs onto the within-shard
         vmap axis (the BENCH_BATCH mode); leftover singleton batches go
         through the per-config path."""
+        obs.record_jax_manifest(mesh=self.mesh)
         scores = dict(ledger or {})
         if config_list is None:
             config_list = cfg.iter_config_keys()

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from flake16_framework_tpu import config as cfg
+from flake16_framework_tpu import config as cfg, obs
 from flake16_framework_tpu.constants import (
     LOPO_SCORES_FILE, SCORES_FILE, SHAP_FILE, TESTS_FILE,
 )
@@ -86,16 +86,18 @@ def write_scores(tests_file=TESTS_FILE, out_file=None, *,
                                engine.fused_configs)
             _dump(live_scores, out_file)
 
-    if profile_dir is not None:
-        with jax.profiler.trace(profile_dir):
+    # The profiler hook is the obs subsystem's trace backend (a None
+    # profile_dir is a no-op); telemetry spans/counters ride the same run.
+    obs.manifest_update(verb="scores", cv=cv, out_file=str(out_file),
+                        fused=fused)
+    with obs.profiler_trace(profile_dir):
+        with obs.span("scores.run_grid", cv=cv):
             scores_all = engine.run_grid(configs, ledger=ledger,
                                          progress=progress)
-    else:
-        scores_all = engine.run_grid(configs, ledger=ledger,
-                                     progress=progress)
     _dump(scores_all, out_file)
     _write_timing_meta(out_file, engine.amortized_configs,
                        engine.fused_configs)
+    obs.emit_memory_gauges()
     return scores_all
 
 
@@ -202,62 +204,78 @@ def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
 
     key = jax.random.PRNGKey(seed)
     if fused_fit and timings is None:
-        fit_fn = _fused_shap_fit(n, spec, max_depth, 4 * n,
-                                 spec.n_trees > 1)
-        xp, forest = fit_fn(x, y, prep, bal, key)
+        with obs.span("shap.config", key=(spec.name, "fused"), mode="fused",
+                      config="/".join(config_keys)):
+            fit_fn = _fused_shap_fit(n, spec, max_depth, 4 * n,
+                                     spec.n_trees > 1)
+            xp, forest = fit_fn(x, y, prep, bal, key)
+            x_explain = xp if n_explain is None else xp[:n_explain]
+            out = np.asarray(
+                treeshap.forest_shap_class0(forest, x_explain,
+                                            sample_chunk=sample_chunk,
+                                            impl=impl,
+                                            tree_chunk=shap_tree_chunk)
+            )
+        obs.counter_add("shap_configs", 1)
+        return out
+    # Staged path: one telemetry span covers the whole config (the final
+    # np.asarray blocks on everything, so its wall is the true config
+    # wall); in timed mode the per-stage attribution rides as span fields.
+    with obs.span("shap.config", key=(spec.name, "staged"), mode="staged",
+                  config="/".join(config_keys)) as _span:
+        t0 = time.time()
+        mu, wmat = jax.jit(fit_preprocess)(x, prep)
+        xp = transform(x, mu, wmat)
+        t0 = _mark("prep_s", t0, xp)
+
+        kb, kf = jax.random.split(key)
+        xs, ys, ws = resample(xp, y, np.ones(n, np.float32), bal, kb, 2 * n)
+        t0 = _mark("resample_s", t0, xs)
+        fit_kw = dict(
+            n_trees=spec.n_trees, bootstrap=spec.bootstrap,
+            random_splits=spec.random_splits,
+            sqrt_features=spec.sqrt_features,
+            max_depth=max_depth, max_nodes=4 * n,
+        )
+        if spec.n_trees > 1:
+            # Ensembles fit via the MXU histogram grower — same policy as
+            # the sweep (parallel/sweep.py _make_config_fns). A single
+            # unchunked 100-tree fit is one fold's worth of the sweep's
+            # 320-instance budget, so no tree_chunk is needed here.
+            # ``fit_dispatch_trees`` splits the fit into bounded-duration
+            # dispatches instead (bit-identical: explicit slices of the
+            # same tree-key table).
+            dc = fit_dispatch_trees
+            if dc is not None and dc < spec.n_trees:
+                tks = jax.random.split(kf, spec.n_trees)
+                # Bin edges once, not per chunk (bit-identical: every chunk
+                # would derive the same edges from the same xs).
+                edges = jax.jit(trees.quantile_edges)(xs)
+                parts = []
+                for lo in range(0, spec.n_trees, dc):
+                    sub_kw = dict(fit_kw,
+                                  n_trees=min(dc, spec.n_trees - lo),
+                                  tree_keys=tks[lo:lo + dc], edges=edges)
+                    part = trees.fit_forest_hist(xs, ys, ws, kf, **sub_kw)
+                    jax.block_until_ready(part)
+                    parts.append(part)
+                forest = trees.concat_trees(parts)
+            else:
+                forest = trees.fit_forest_hist(xs, ys, ws, kf, **fit_kw)
+        else:
+            forest = trees.fit_forest(xs, ys, ws, kf, **fit_kw)
+        t0 = _mark("fit_s", t0, forest)
         x_explain = xp if n_explain is None else xp[:n_explain]
-        return np.asarray(
+        out = np.asarray(
             treeshap.forest_shap_class0(forest, x_explain,
                                         sample_chunk=sample_chunk,
                                         impl=impl,
                                         tree_chunk=shap_tree_chunk)
         )
-    t0 = time.time()
-    mu, wmat = jax.jit(fit_preprocess)(x, prep)
-    xp = transform(x, mu, wmat)
-    t0 = _mark("prep_s", t0, xp)
-
-    kb, kf = jax.random.split(key)
-    xs, ys, ws = resample(xp, y, np.ones(n, np.float32), bal, kb, 2 * n)
-    t0 = _mark("resample_s", t0, xs)
-    fit_kw = dict(
-        n_trees=spec.n_trees, bootstrap=spec.bootstrap,
-        random_splits=spec.random_splits, sqrt_features=spec.sqrt_features,
-        max_depth=max_depth, max_nodes=4 * n,
-    )
-    if spec.n_trees > 1:
-        # Ensembles fit via the MXU histogram grower — same policy as the
-        # sweep (parallel/sweep.py _make_config_fns). A single unchunked
-        # 100-tree fit is one fold's worth of the sweep's 320-instance
-        # budget, so no tree_chunk is needed here. ``fit_dispatch_trees``
-        # splits the fit into bounded-duration dispatches instead
-        # (bit-identical: explicit slices of the same tree-key table).
-        dc = fit_dispatch_trees
-        if dc is not None and dc < spec.n_trees:
-            tks = jax.random.split(kf, spec.n_trees)
-            # Bin edges once, not per chunk (bit-identical: every chunk
-            # would derive the same edges from the same xs).
-            edges = jax.jit(trees.quantile_edges)(xs)
-            parts = []
-            for lo in range(0, spec.n_trees, dc):
-                sub_kw = dict(fit_kw, n_trees=min(dc, spec.n_trees - lo),
-                              tree_keys=tks[lo:lo + dc], edges=edges)
-                part = trees.fit_forest_hist(xs, ys, ws, kf, **sub_kw)
-                jax.block_until_ready(part)
-                parts.append(part)
-            forest = trees.concat_trees(parts)
-        else:
-            forest = trees.fit_forest_hist(xs, ys, ws, kf, **fit_kw)
-    else:
-        forest = trees.fit_forest(xs, ys, ws, kf, **fit_kw)
-    t0 = _mark("fit_s", t0, forest)
-    x_explain = xp if n_explain is None else xp[:n_explain]
-    out = np.asarray(
-        treeshap.forest_shap_class0(forest, x_explain,
-                                    sample_chunk=sample_chunk, impl=impl,
-                                    tree_chunk=shap_tree_chunk)
-    )
-    _mark("explain_s", t0)
+        _mark("explain_s", t0)
+        if timings is not None:
+            _span.add(**timings)
+    obs.counter_add("shap_configs", 1)
     return out
 
 
@@ -265,12 +283,16 @@ def write_shap(tests_file=TESTS_FILE, out_file=SHAP_FILE, *, max_depth=48,
                tree_overrides=None, sample_chunk=512, impl="auto"):
     """The two paper configs (reference write_shap experiment.py:520-530)."""
     feats, labels, _, _, _ = _load_arrays(tests_file)
-    values = [
-        shap_for_config(keys, feats, labels, max_depth=max_depth,
-                        tree_overrides=tree_overrides,
-                        sample_chunk=sample_chunk, impl=impl)
-        for keys in cfg.SHAP_CONFIGS
-    ]
+    obs.manifest_update(verb="shap", out_file=str(out_file))
+    obs.record_jax_manifest()
+    with obs.span("shap.total"):
+        values = [
+            shap_for_config(keys, feats, labels, max_depth=max_depth,
+                            tree_overrides=tree_overrides,
+                            sample_chunk=sample_chunk, impl=impl)
+            for keys in cfg.SHAP_CONFIGS
+        ]
     with open(out_file, "wb") as fd:
         pickle.dump(values, fd)
+    obs.emit_memory_gauges()
     return values

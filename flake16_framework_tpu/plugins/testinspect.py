@@ -37,7 +37,13 @@ import pytest
 from flake16_framework_tpu.plugins.churn import git_churn
 from flake16_framework_tpu.plugins.static_features import ModuleAnalyzer
 
-_TOOL = sys.monitoring.COVERAGE_ID
+# sys.monitoring is PEP 669 (Python 3.12+). The plugin must stay importable
+# on older interpreters — it is registered as a pytest11 entry point, so a
+# module-level dereference would crash EVERY pytest run in a 3.10 venv, not
+# just --testinspect ones. The flag itself degrades with a clean usage
+# error below.
+_MONITORING = getattr(sys, "monitoring", None)
+_TOOL = _MONITORING.COVERAGE_ID if _MONITORING is not None else None
 
 
 def lines_to_numbits(lines):
@@ -60,6 +66,12 @@ def pytest_addoption(parser):
 def pytest_configure(config):
     base = config.getoption("--testinspect")
     if base:
+        if _MONITORING is None:
+            raise pytest.UsageError(
+                "--testinspect requires Python 3.12+ (line coverage is "
+                "traced via sys.monitoring, PEP 669); this interpreter is "
+                + sys.version.split()[0]
+            )
         config.pluginmanager.register(
             _TestInspect(base, str(config.rootpath)), "_testinspect_impl"
         )

@@ -6,6 +6,7 @@ import os
 import pickle
 import sqlite3
 import subprocess
+import sys
 import textwrap
 
 import pytest
@@ -18,6 +19,14 @@ from flake16_framework_tpu.runner.collate import numbits_to_lines
 pytest_plugins = ["pytester"]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# testinspect traces line coverage via sys.monitoring (PEP 669): the
+# instrumented-run tests need 3.12+, everything else in this module (the
+# showflakes plugin, numbits codec, churn, static features) runs anywhere.
+needs_monitoring = pytest.mark.skipif(
+    not hasattr(sys, "monitoring"),
+    reason="testinspect requires sys.monitoring (Python 3.12+)",
+)
 
 
 def _run(pytester, *args):
@@ -102,6 +111,7 @@ def test_showflakes_exit_nonzero_without_set_exitstatus(toy_suite):
     assert res.ret == pytest.ExitCode.TESTS_FAILED
 
 
+@needs_monitoring
 def test_testinspect_artifacts(toy_suite):
     res = _run(
         toy_suite, "-p", "flake16_framework_tpu.plugins.testinspect",
@@ -158,6 +168,23 @@ def test_testinspect_artifacts(toy_suite):
     assert churn == {}  # pytester tmp dir is not a git repo
 
 
+@pytest.mark.skipif(
+    hasattr(sys, "monitoring"),
+    reason="degrade path only exists on Python < 3.12",
+)
+def test_testinspect_flag_degrades_cleanly_without_monitoring(toy_suite):
+    # On < 3.12 the plugin module must import (pytest11 entry point: a
+    # crash here would break every pytest run in a subject venv) and the
+    # flag must fail with a clean usage error naming the requirement.
+    res = _run(
+        toy_suite, "-p", "flake16_framework_tpu.plugins.testinspect",
+        "--testinspect=insp",
+    )
+    assert res.ret == pytest.ExitCode.USAGE_ERROR
+    res.stderr.fnmatch_lines(["*--testinspect requires Python 3.12+*"])
+
+
+@needs_monitoring
 def test_full_collection_loop_to_tests_json(tmp_path):
     """End-to-end L1->L3: run both plugins on a toy git subject across
     baseline + shuffled campaigns, collate the contract-named artifacts, and

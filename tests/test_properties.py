@@ -10,7 +10,14 @@ keep the suite's wall-clock budget."""
 
 import jax
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# hypothesis is a dev extra (pyproject [project.optional-dependencies]),
+# not a runtime dep: skip the module cleanly where it isn't installed
+# instead of erroring the whole collection.
+pytest.importorskip("hypothesis")
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from flake16_framework_tpu.ops.trees import (
     fit_forest, fit_forest_hist, predict_proba,

@@ -38,12 +38,19 @@ def main():
     import jax
 
     import bench
+    from flake16_framework_tpu import obs
     from flake16_framework_tpu.parallel import sweep
 
     bench.configure_jax_cache()
     feats, labels, projects, names, pids = bench.make_data(N_TESTS)
     engine = sweep.SweepEngine(feats, labels, projects, names, pids,
                                fused=True)
+    # Telemetry (F16_TELEMETRY=1): the engine stamps spans/counters per
+    # config; the heartbeat (auto-started on configure) is what makes a
+    # dead multi-hour grid session diagnosable — the round-5 run went
+    # 8.3 h with no liveness trail beyond the progress log.
+    obs.manifest_update(verb="grid_fullshape", n_tests=N_TESTS)
+    obs.record_jax_manifest()
 
     # Per-meta ledger (same scheme as grid_tpu.ledger_path): resumes only
     # runs of the SAME experiment — a GRID_N_TESTS smoke run or a silent
@@ -99,6 +106,7 @@ def main():
         with open(record_file + ".tmp", "w") as fd:
             json.dump(rec, fd, indent=1)
         os.replace(record_file + ".tmp", record_file)
+        obs.emit_memory_gauges()
         return rec
 
     def progress(i, total, keys, live):
