@@ -9,11 +9,14 @@
     python -m flake16_framework_tpu shap        # TPU Tree SHAP -> shap.pkl
     python -m flake16_framework_tpu figures     # LaTeX artifacts
 
-plus one extension verb the reference lacks:
+plus two extension verbs the reference lacks:
 
     python -m flake16_framework_tpu report [RUN_DIR] [--json]
         # render a telemetry run (F16_TELEMETRY=1 during scores/shap/bench)
         # into per-stage compile/execute walls, throughput, memory peaks
+    python -m flake16_framework_tpu lint [PATHS] [--json] [--baseline F]
+        # f16lint: JAX/TPU-hygiene static analysis + 216-config grid
+        # pre-flight (analysis/); exit 1 on unsuppressed findings
 
 Unknown/missing verbs raise ValueError like the reference.
 """
@@ -79,9 +82,23 @@ def main(argv=None):
         from flake16_framework_tpu.obs.report import report_main
 
         report_main(args)
+    elif command == "lint":
+        from flake16_framework_tpu.analysis.cli import lint_main
+
+        code = lint_main(args)
+        if code:
+            raise SystemExit(code)
     else:
         raise ValueError("Unrecognized command given")
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BrokenPipeError:
+        # `lint | head` etc. — the reader went away; swap stdout for
+        # devnull so interpreter shutdown doesn't re-raise on flush.
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        raise SystemExit(1)

@@ -1,0 +1,23 @@
+"""f16lint — AST-based JAX/TPU-hygiene static analysis + grid pre-flight.
+
+The launch-time twin of the telemetry subsystem (obs/): catch host
+syncs, retrace hazards, dtype drift, a malformed 216-config grid, and
+telemetry schema drift on the HOST, in seconds, before a device is ever
+touched (ISSUE 2; PROFILE.md "Static analysis" has the rule catalog).
+
+    python -m flake16_framework_tpu lint [PATHS] [--json] [--baseline F]
+
+Engine mechanics in engine.py; rule packs in rules_jax.py (J-rules),
+rules_grid.py (G-rules), rules_obs.py (O-rules); CLI in cli.py. Nothing
+here imports jax.
+"""
+
+from flake16_framework_tpu.analysis.engine import (  # noqa: F401
+    Engine,
+    Finding,
+    LintResult,
+    Module,
+    RuleInfo,
+    load_baseline,
+    save_baseline,
+)

@@ -1,0 +1,95 @@
+"""The ``lint`` CLI verb (``__main__.py``), mirroring ``report``:
+
+    python -m flake16_framework_tpu lint [PATHS...] [--json]
+        [--baseline FILE] [--telemetry PATH] [--rules]
+
+With no PATHS the package itself is linted (the CI gate invocation —
+tests/test_lint.py shells exactly this and asserts exit 0). ``--json``
+prints the ``lint-report-v1`` document (obs.schema.LINT_SCHEMA — same
+schema family as telemetry, validated by the same drift lint).
+``--baseline`` subtracts a recorded fingerprint multiset
+(tools/gen_lint_baseline.py writes one). ``--telemetry`` additionally
+validates emitted telemetry documents at PATH (repeatable — the folded-in
+tools/check_telemetry_schema.py behavior). ``--rules`` prints the rule
+catalog and exits 0.
+
+Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = usage error
+(mirroring the ValueError convention of the other verbs).
+"""
+
+import json
+import os
+import sys
+
+from flake16_framework_tpu.analysis import engine as eng
+from flake16_framework_tpu.analysis import rules_grid, rules_jax, rules_obs
+
+PACKS = (rules_jax, rules_grid, rules_obs)
+
+
+def default_paths():
+    """The package directory — what the CI gate lints."""
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def build_engine():
+    return eng.Engine(PACKS)
+
+
+def run_lint(paths=None, baseline_file=None, telemetry_paths=()):
+    """(LintResult, telemetry-doc findings folded in) for PATHS."""
+    engine = build_engine()
+    result = engine.lint(paths or default_paths(),
+                         baseline=eng.load_baseline(baseline_file))
+    if telemetry_paths:
+        result.findings.extend(rules_obs.check_docs(telemetry_paths))
+    return result
+
+
+def lint_main(args, out=None):
+    out = out or sys.stdout
+    as_json = False
+    show_rules = False
+    baseline = None
+    telemetry = []
+    paths = []
+    it = iter(args)
+    for a in it:
+        if a == "--json":
+            as_json = True
+        elif a == "--rules":
+            show_rules = True
+        elif a == "--baseline":
+            baseline = next(it, None)
+            if baseline is None:
+                raise ValueError("--baseline needs a file argument")
+        elif a == "--telemetry":
+            t = next(it, None)
+            if t is None:
+                raise ValueError("--telemetry needs a path argument")
+            telemetry.append(t)
+        elif a.startswith("--"):
+            raise ValueError(f"Unrecognized lint option {a!r}")
+        else:
+            paths.append(a)
+
+    if show_rules:
+        engine = build_engine()
+        for r in sorted(engine.rules.values(), key=lambda r: r.id):
+            out.write(f"{r.id:<6}{r.severity:<9}{r.doc}\n")
+        return 0
+
+    result = run_lint(paths, baseline_file=baseline,
+                      telemetry_paths=telemetry)
+    report = result.to_report()
+    if as_json:
+        out.write(json.dumps(report, indent=1) + "\n")
+    else:
+        for f in result.findings:
+            out.write(f.render() + "\n")
+        c = report["counts"]
+        out.write(
+            f"{c['errors']} error(s), {c['warnings']} warning(s) over "
+            f"{c['files']} file(s); suppressed: {c['suppressed_inline']} "
+            f"inline, {c['suppressed_baseline']} baseline\n")
+    return 1 if result.findings else 0

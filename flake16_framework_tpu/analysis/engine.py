@@ -1,0 +1,290 @@
+"""f16lint engine — AST static analysis, the host-side pre-flight twin of
+the telemetry subsystem (obs/): telemetry explains a run after the fact,
+f16lint refuses the classes of defect that burn a TPU allocation *before*
+launch (ISSUE 2; PROFILE.md "Static analysis").
+
+Mechanics, not rules, live here. A rule *pack* is a module exposing
+
+    RULES : {rule_id: RuleInfo}            # the pack's catalog
+    check_module(mod)  -> iter[Finding]    # optional, per parsed file
+    check_project(mods) -> iter[Finding]   # optional, once per lint run
+
+(the packs: rules_jax — TPU hygiene; rules_grid — 216-config grid
+pre-flight; rules_obs — telemetry schema drift). The engine parses each
+``.py`` once into a ``Module`` (source + AST + suppression table) and
+funnels every pack's findings through the two suppression layers:
+
+- inline: ``# f16lint: disable=J101,J402`` on the offending line (bare
+  ``disable`` silences every rule on that line); ``disable-file=RULE``
+  anywhere in the file silences a rule for the whole file.
+- baseline: a JSON file of finding fingerprints (multiset — N entries
+  absorb N findings). Fingerprints hash (path, rule, source snippet),
+  not line numbers, so unrelated edits above a known finding don't
+  invalidate the baseline. ``tools/gen_lint_baseline.py`` regenerates.
+
+Nothing in this package imports jax: the grid pre-flight acceptance bar
+is "reject a broken grid in seconds without touching a device", and an
+import of jax is already a device backend negotiation.
+"""
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass
+
+ERROR, WARNING = "error", "warning"
+BASELINE_SCHEMA = "flake16-lint-baseline-v1"
+
+# One engine-owned rule: a file the AST rules never saw is a finding, not
+# a silent skip (a syntax error in a sweep module would otherwise pass).
+PARSE_RULE = "E001"
+
+
+@dataclass(frozen=True)
+class RuleInfo:
+    id: str
+    severity: str
+    doc: str
+
+
+ENGINE_RULES = {
+    PARSE_RULE: RuleInfo(PARSE_RULE, ERROR, "file does not parse"),
+}
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+
+    @property
+    def fingerprint(self):
+        """Stable identity for baselines: path + rule + source snippet
+        (NOT the line number — edits above a finding must not churn the
+        baseline)."""
+        h = hashlib.sha1(
+            f"{self.path}::{self.rule}::{self.snippet.strip()}".encode()
+        ).hexdigest()[:16]
+        return f"{self.rule}:{h}"
+
+    def as_dict(self):
+        return {
+            "rule": self.rule, "severity": self.severity, "path": self.path,
+            "line": self.line, "col": self.col, "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+    def render(self):
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} [{self.severity}] {self.message}")
+
+
+_DISABLE_RE = re.compile(
+    r"#\s*f16lint:\s*disable(?P<file>-file)?"
+    r"(?:=(?P<rules>[A-Za-z0-9_,\s-]+))?")
+
+
+class Module:
+    """One parsed source file: AST + per-line/per-file suppression table.
+
+    ``tree`` is None when the file does not parse; the engine turns that
+    into a PARSE_RULE finding instead of running rules on it."""
+
+    def __init__(self, path, src=None):
+        self.path = normpath(path)
+        if src is None:
+            with open(path, encoding="utf-8", errors="replace") as fd:
+                src = fd.read()
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = None
+        self.parse_error = None
+        try:
+            self.tree = ast.parse(src)
+        except SyntaxError as e:
+            self.parse_error = e
+        self.file_disables = set()
+        self.line_disables = {}
+        for lineno, line in enumerate(self.lines, start=1):
+            m = _DISABLE_RE.search(line)
+            if not m:
+                continue
+            rules = m.group("rules")
+            ids = ({r.strip() for r in rules.split(",") if r.strip()}
+                   if rules else {"*"})
+            if m.group("file"):
+                self.file_disables |= ids
+            else:
+                self.line_disables.setdefault(lineno, set()).update(ids)
+
+    def suppressed(self, rule, line):
+        if "*" in self.file_disables or rule in self.file_disables:
+            return True
+        ids = self.line_disables.get(line)
+        return ids is not None and ("*" in ids or rule in ids)
+
+    def line_text(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def finding(self, rule_id, severity, node, message):
+        """Finding anchored at an AST node, snippet auto-filled."""
+        line = getattr(node, "lineno", 0)
+        return Finding(rule_id, severity, self.path, line,
+                       getattr(node, "col_offset", 0), message,
+                       snippet=self.line_text(line))
+
+
+def normpath(path):
+    """Repo-relative posix path when under the CWD (stable fingerprints
+    across checkouts), absolute otherwise."""
+    apath = os.path.abspath(path)
+    cwd = os.getcwd()
+    if apath == cwd or apath.startswith(cwd + os.sep):
+        apath = os.path.relpath(apath, cwd)
+    return apath.replace(os.sep, "/")
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+        elif p.endswith(".py") or os.path.isfile(p):
+            yield p
+
+
+class LintResult:
+    def __init__(self, findings, *, suppressed_inline, suppressed_baseline,
+                 n_files, rules):
+        self.findings = findings
+        self.suppressed_inline = suppressed_inline
+        self.suppressed_baseline = suppressed_baseline
+        self.n_files = n_files
+        self.rules = rules
+
+    @property
+    def errors(self):
+        return [f for f in self.findings if f.severity == ERROR]
+
+    @property
+    def warnings(self):
+        return [f for f in self.findings if f.severity == WARNING]
+
+    def to_report(self):
+        """The ``lint-report-v1`` document (obs.schema.LINT_SCHEMA — the
+        same JSONL/JSON schema family as telemetry events and reports)."""
+        from flake16_framework_tpu.obs import schema
+
+        return {
+            "schema": schema.LINT_SCHEMA,
+            "findings": [f.as_dict() for f in self.findings],
+            "counts": {
+                "errors": len(self.errors),
+                "warnings": len(self.warnings),
+                "suppressed_inline": self.suppressed_inline,
+                "suppressed_baseline": self.suppressed_baseline,
+                "files": self.n_files,
+            },
+            "rules": {r.id: {"severity": r.severity, "doc": r.doc}
+                      for r in sorted(self.rules.values(),
+                                      key=lambda r: r.id)},
+        }
+
+
+class Engine:
+    """Run rule packs over paths; apply suppressions and baseline."""
+
+    def __init__(self, packs):
+        self.packs = list(packs)
+        self.rules = dict(ENGINE_RULES)
+        for p in self.packs:
+            dup = set(self.rules) & set(p.RULES)
+            if dup:
+                raise ValueError(f"duplicate rule ids across packs: {dup}")
+            self.rules.update(p.RULES)
+
+    def parse(self, paths):
+        return [Module(f) for f in iter_py_files(paths)]
+
+    def lint(self, paths, baseline=None):
+        modules = self.parse(paths)
+        findings = []
+        for mod in modules:
+            if mod.tree is None:
+                e = mod.parse_error
+                findings.append(Finding(
+                    PARSE_RULE, ERROR, mod.path, e.lineno or 0,
+                    (e.offset or 1) - 1, f"syntax error: {e.msg}",
+                    snippet=e.text or ""))
+                continue
+            for p in self.packs:
+                check = getattr(p, "check_module", None)
+                if check is not None:
+                    findings.extend(check(mod))
+        parsed = [m for m in modules if m.tree is not None]
+        for p in self.packs:
+            check = getattr(p, "check_project", None)
+            if check is not None:
+                findings.extend(check(parsed))
+
+        by_path = {m.path: m for m in modules}
+        kept, n_inline = [], 0
+        for f in findings:
+            mod = by_path.get(f.path)
+            if mod is not None and mod.suppressed(f.rule, f.line):
+                n_inline += 1
+            else:
+                kept.append(f)
+
+        budget = {}
+        for fp in (baseline or ()):
+            budget[fp] = budget.get(fp, 0) + 1
+        final, n_base = [], 0
+        for f in kept:
+            fp = f.fingerprint
+            if budget.get(fp, 0) > 0:
+                budget[fp] -= 1
+                n_base += 1
+            else:
+                final.append(f)
+        final.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return LintResult(
+            final, suppressed_inline=n_inline, suppressed_baseline=n_base,
+            n_files=len(modules), rules=self.rules)
+
+
+def load_baseline(path):
+    """Fingerprint list from a baseline file (empty when absent: a fresh
+    checkout with no baseline is not a lint failure)."""
+    if path is None or not os.path.exists(path):
+        return []
+    with open(path) as fd:
+        obj = json.load(fd)
+    if not isinstance(obj, dict) or obj.get("schema") != BASELINE_SCHEMA:
+        raise ValueError(
+            f"{path}: not a {BASELINE_SCHEMA} baseline document")
+    return list(obj.get("fingerprints", []))
+
+
+def save_baseline(path, findings):
+    obj = {
+        "schema": BASELINE_SCHEMA,
+        "fingerprints": sorted(f.fingerprint for f in findings),
+    }
+    with open(path, "w") as fd:
+        json.dump(obj, fd, indent=1)
+        fd.write("\n")
+    return obj

@@ -1,0 +1,224 @@
+"""Rule pack 2 — grid pre-flight (G-rules).
+
+Statically validate the full 2x2x3x6x3 = 216-config grid against the
+implemented kernel registry BEFORE a multi-hour TPU run: a malformed
+config axis must fail in seconds on the host, not hours into an
+allocation (ISSUE 2 acceptance: reject a broken grid in <5s without
+touching a device — nothing in this module imports jax).
+
+Checks, each its own rule id:
+
+- G101 grid-shape: five non-empty dict axes; the paper grid multiplies
+  out to exactly 216 configs.
+- G102 kernel-registry: preprocessing/balancing codes are EXACTLY
+  ``range(len(axis))`` — they index ``lax.switch`` branch tuples, so a
+  gap or duplicate silently runs the wrong kernel (worse than a crash);
+  the static branch-tuple arity in ops/preprocess.py and ops/resample.py
+  must match the axis size; every (prep, bal, model) triple resolves.
+- G103 static-hashability: model specs and feature-set column tuples
+  must be hashable — they key the per-family compile caches in
+  parallel/sweep.py (``_get_fns``); an unhashable spec retraces per
+  config instead of once per family.
+- G104 padded-shapes: feature columns are unique ints inside
+  ``range(n_features)`` (column indexing into the padded [N, F] matrix).
+- G105 span-collision: a telemetry span name declared in two different
+  modules merges unrelated timings in ``report`` — span names must be
+  unique per module (the sweep/pipeline naming contract, obs/report.py).
+
+``preflight_grid`` is callable with injected axes so tests (and future
+config loaders) can validate a candidate grid without editing config.py.
+"""
+
+import ast
+import os
+
+from flake16_framework_tpu.analysis.engine import (
+    ERROR, WARNING, Finding, RuleInfo, normpath,
+)
+
+RULES = {r.id: r for r in (
+    RuleInfo("G101", ERROR, "grid axes malformed or config count drifted"),
+    RuleInfo("G102", ERROR,
+             "axis code does not resolve to a real kernel (lax.switch"
+             " registry mismatch)"),
+    RuleInfo("G103", ERROR,
+             "static spec unhashable — defeats the per-family compile"
+             " cache (retrace per config)"),
+    RuleInfo("G104", ERROR, "feature columns out of range or duplicated"),
+    RuleInfo("G105", WARNING,
+             "telemetry span name declared in more than one module"),
+)}
+
+PAPER_GRID_SIZE = 216
+
+
+def _finding(rule_id, message, path="flake16_framework_tpu/config.py",
+             line=0):
+    return Finding(rule_id, RULES[rule_id].severity, path, line, 0,
+                   message, snippet=message)
+
+
+def _switch_arity(path):
+    """Largest ``lax.switch(code, (branches...))`` branch-tuple arity in a
+    file, by AST (None when the file has no literal-tuple switch). This is
+    the *implemented* kernel count the config axis must match."""
+    try:
+        with open(path, encoding="utf-8") as fd:
+            tree = ast.parse(fd.read())
+    except (OSError, SyntaxError):
+        return None
+    best = None
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "switch"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], (ast.Tuple, ast.List))):
+            arity = len(node.args[1].elts)
+            best = arity if best is None else max(best, arity)
+    return best
+
+
+def default_switch_arities():
+    """The implemented kernel counts, read off the ops sources."""
+    ops = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "ops")
+    return {
+        "preprocessing": _switch_arity(os.path.join(ops, "preprocess.py")),
+        "balancing": _switch_arity(os.path.join(ops, "resample.py")),
+    }
+
+
+def preflight_grid(axes=None, *, n_features=None, expected_size=None,
+                   switch_arities=None):
+    """Validate a candidate grid (default: the real config.GRID_AXES)
+    against the kernel registry. Returns a list of Findings — empty means
+    the grid is launchable. Pure host-side; never imports jax."""
+    if axes is None:
+        from flake16_framework_tpu import config as cfg
+
+        axes = cfg.GRID_AXES
+        expected_size = (PAPER_GRID_SIZE if expected_size is None
+                         else expected_size)
+    if n_features is None:
+        from flake16_framework_tpu.constants import N_FEATURES
+
+        n_features = N_FEATURES
+    if switch_arities is None:
+        switch_arities = default_switch_arities()
+
+    findings = []
+
+    # G101: shape of the grid itself.
+    if len(axes) != 5:
+        findings.append(_finding(
+            "G101", f"grid has {len(axes)} axes, want 5 "
+            "(flaky, feature-set, preprocessing, balancing, model)"))
+        return findings
+    names = ("flaky", "feature-set", "preprocessing", "balancing", "model")
+    size = 1
+    for name, axis in zip(names, axes):
+        if not isinstance(axis, dict) or not axis:
+            findings.append(_finding(
+                "G101", f"{name} axis is not a non-empty dict"))
+            return findings
+        size *= len(axis)
+    if expected_size is not None and size != expected_size:
+        findings.append(_finding(
+            "G101", f"grid multiplies out to {size} configs, "
+            f"want {expected_size}"))
+
+    flaky, feature_sets, preps, bals, models = axes
+
+    # G102: switch-indexed axes must be exactly range(len(axis)).
+    for name, axis in (("preprocessing", preps), ("balancing", bals)):
+        codes = sorted(v for v in axis.values() if isinstance(v, int))
+        if len(codes) != len(axis) or codes != list(range(len(axis))):
+            findings.append(_finding(
+                "G102", f"{name} codes {sorted(axis.values())!r} are not "
+                f"exactly range({len(axis)}) — lax.switch would clamp or "
+                "run the wrong kernel"))
+        arity = switch_arities.get(name)
+        if arity is not None and arity != len(axis):
+            findings.append(_finding(
+                "G102", f"{name} axis has {len(axis)} settings but the "
+                f"implemented lax.switch dispatches {arity} kernels"))
+    for name, label in flaky.items():
+        if not isinstance(label, int):
+            findings.append(_finding(
+                "G102", f"flaky type {name!r} label {label!r} is not an "
+                "int class label"))
+
+    # G102/G103: every model resolves to a fit-able static spec.
+    for name, spec in models.items():
+        n_trees = getattr(spec, "n_trees", None)
+        if not isinstance(n_trees, int) or n_trees < 1:
+            findings.append(_finding(
+                "G102", f"model {name!r} has no positive int n_trees "
+                f"({n_trees!r}) — no fused/staged fit path exists for it"))
+        try:
+            hash(spec)
+        except TypeError:
+            findings.append(_finding(
+                "G103", f"model spec {name!r} is unhashable — it keys the "
+                "per-family jit cache (sweep._get_fns)"))
+
+    # G103/G104: feature sets are hashable tuples of in-range columns.
+    for name, cols in feature_sets.items():
+        try:
+            hash(cols)
+        except TypeError:
+            findings.append(_finding(
+                "G103", f"feature set {name!r} columns are unhashable "
+                f"({type(cols).__name__}) — must be a tuple"))
+        cols_list = list(cols)
+        if not cols_list:
+            findings.append(_finding(
+                "G104", f"feature set {name!r} is empty"))
+            continue
+        bad = [c for c in cols_list
+               if not isinstance(c, int) or not 0 <= c < n_features]
+        if bad:
+            findings.append(_finding(
+                "G104", f"feature set {name!r} columns {bad!r} outside "
+                f"range({n_features})"))
+        if len(set(cols_list)) != len(cols_list):
+            findings.append(_finding(
+                "G104", f"feature set {name!r} has duplicate columns"))
+    return findings
+
+
+def _span_names(mod):
+    """(name, lineno) for every literal obs.span("name", ...) in a module."""
+    out = []
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "span"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            out.append((node.args[0].value, node.lineno))
+    return out
+
+
+def check_project(modules):
+    """Grid pre-flight + cross-module span uniqueness, once per lint run."""
+    findings = list(preflight_grid())
+
+    owners = {}
+    for mod in modules:
+        if "/tests/" in f"/{mod.path}" or mod.path.startswith("tests/"):
+            continue  # test fixtures may reuse production span names
+        for name, lineno in _span_names(mod):
+            owners.setdefault(name, []).append((mod.path, lineno))
+    for name, sites in sorted(owners.items()):
+        paths = sorted({p for p, _ in sites})
+        if len(paths) > 1:
+            path, lineno = sites[-1]
+            findings.append(Finding(
+                "G105", RULES["G105"].severity, normpath(path), lineno, 0,
+                f"span name {name!r} declared in {len(paths)} modules "
+                f"({', '.join(paths)}) — report would merge their walls",
+                snippet=name))
+    return findings

@@ -1,0 +1,344 @@
+"""Rule pack 1 — JAX/TPU hygiene (J-rules).
+
+The defect classes that turn a multi-hour 216-config sweep into a wasted
+allocation (ISSUE 2; PROFILE.md round-3: per-dispatch tunnel round-trips,
+not compute, dominate per-config cost):
+
+- implicit host syncs inside jit-reachable code (J101-J104): a
+  ``float()`` or ``if`` on a traced array forces a device readback per
+  call — invisible in code review, obvious in a profiler after the run;
+- retrace hazards (J201-J203): unhashable statics, order-unstable set
+  iteration feeding closures, and jit-in-a-loop all recompile per config
+  instead of once per model family (the sweep's whole compile economy,
+  parallel/sweep.py);
+- dtype drift (J301): an explicit float64 under disabled x64 silently
+  downcasts — parity bugs that surface as F1 noise, not errors;
+- leftover instrumentation (J401, J402): ``jax.debug.print`` and
+  per-iteration ``block_until_ready`` serialize the dispatch pipeline.
+
+Reachability is a module-local static approximation: a function is
+*jit-reachable* when it is decorated with ``jax.jit`` (bare or via
+``functools.partial``), passed by name to a jit/vmap/shard_map/lax
+combinator, or (transitively) defined in or called from such a function.
+*Traced* names originate from jnp/jax.random/jax.lax/jax.nn calls within
+the same function (parameters are deliberately NOT assumed traced —
+static_argnames and host drivers would drown the signal in false
+positives); ``.shape``/``.dtype``/``.ndim``/``len()`` derivations are
+host values and break the taint.
+"""
+
+import ast
+
+from flake16_framework_tpu.analysis.engine import (
+    ERROR, WARNING, RuleInfo,
+)
+
+RULES = {r.id: r for r in (
+    RuleInfo("J101", ERROR,
+             "float()/int()/bool() on a traced value in jit-reachable code"
+             " — implicit host sync per call"),
+    RuleInfo("J102", ERROR,
+             ".item() in jit-reachable code — device->host readback"),
+    RuleInfo("J103", ERROR,
+             "np.asarray/np.array on a traced value in jit-reachable code"
+             " — silent device->host transfer"),
+    RuleInfo("J104", ERROR,
+             "Python if/while on a traced value — ConcretizationTypeError"
+             " under jit, or a silent host sync outside it"),
+    RuleInfo("J201", WARNING,
+             "static_argnums/static_argnames given a mutable literal —"
+             " unhashable statics retrace (or TypeError) per call"),
+    RuleInfo("J202", WARNING,
+             "iteration over a set — nondeterministic order; feeding jit"
+             " closures or sweep schedules makes retraces run-dependent"),
+    RuleInfo("J203", WARNING,
+             "jax.jit called inside a loop body — a fresh wrapper per"
+             " iteration defeats the trace cache (retrace per config)"),
+    RuleInfo("J301", ERROR,
+             "explicit float64 dtype in a jnp call — silently downcast"
+             " to float32 when jax_enable_x64 is off"),
+    RuleInfo("J401", ERROR,
+             "leftover jax.debug.print/jax.debug.breakpoint"),
+    RuleInfo("J402", WARNING,
+             "block_until_ready inside a loop body — serializes the"
+             " dispatch pipeline (one tunnel round-trip per iteration)"),
+)}
+
+# Call roots whose results are traced arrays (after alias resolution).
+_TRACED_ROOTS = (
+    "jax.numpy.", "jax.random.", "jax.lax.", "jax.nn.", "jax.scipy.",
+)
+# Combinators whose function arguments become jit-reachable.
+_JIT_WRAPPERS = {
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.lax.scan", "jax.lax.cond", "jax.lax.switch",
+    "jax.lax.while_loop", "jax.lax.fori_loop", "jax.lax.map",
+    "jax.checkpoint", "jax.remat", "jax.grad", "jax.value_and_grad",
+}
+# Attribute access that turns a traced value back into a host value.
+_HOST_ATTRS = {"shape", "dtype", "ndim", "size"}
+
+
+def _import_aliases(tree):
+    """local name -> dotted module path, from import statements."""
+    aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _dotted(node, aliases):
+    """Resolve Name/Attribute chains to a dotted path with import aliases
+    applied (``jnp.zeros`` -> ``jax.numpy.zeros``); None for non-chains."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    parts.reverse()
+    root = aliases.get(parts[0], parts[0])
+    return ".".join([root] + parts[1:])
+
+
+def _is_set_expr(node, aliases):
+    if isinstance(node, ast.Set):
+        return True
+    if isinstance(node, ast.Call):
+        return _dotted(node.func, aliases) in ("set", "frozenset")
+    return False
+
+
+class _Reach:
+    """The module's jit-reachable function set (see module docstring)."""
+
+    def __init__(self, tree, aliases):
+        self.aliases = aliases
+        # name -> [FunctionDef] (any nesting level; approximation)
+        self.defs_by_name = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs_by_name.setdefault(node.name, []).append(node)
+        seeds = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(self._jit_decorator(d) for d in node.decorator_list):
+                    seeds.add(node)
+            elif isinstance(node, ast.Call):
+                if _dotted(node.func, aliases) in _JIT_WRAPPERS:
+                    for ref in ast.walk(node):
+                        if isinstance(ref, ast.Name):
+                            seeds.update(self.defs_by_name.get(ref.id, ()))
+        # Transitive closure: nested defs of a reachable function, and
+        # module-local functions it calls by name.
+        reachable = set()
+        frontier = list(seeds)
+        while frontier:
+            fn = frontier.pop()
+            if fn in reachable:
+                continue
+            reachable.add(fn)
+            for node in ast.walk(fn):
+                if node is not fn and isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    frontier.append(node)
+                elif isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Name):
+                    frontier.extend(
+                        self.defs_by_name.get(node.func.id, ()))
+        self.reachable = reachable
+
+    def _jit_decorator(self, dec):
+        d = _dotted(dec, self.aliases)
+        if d in ("jax.jit", "jit", "jax.pmap"):
+            return True
+        if isinstance(dec, ast.Call):
+            f = _dotted(dec.func, self.aliases)
+            if f in ("jax.jit", "jit", "jax.pmap"):
+                return True
+            if f in ("functools.partial", "partial") and dec.args:
+                return _dotted(dec.args[0], self.aliases) in (
+                    "jax.jit", "jit", "jax.pmap")
+        return False
+
+
+def _traced_names(fn, aliases):
+    """Names in ``fn`` (own body only, nested defs excluded) assigned from
+    jnp/jax.random/jax.lax/... calls, with taint propagation through
+    expressions; ``.shape``-like access and len() break the taint."""
+    traced = set()
+
+    def own_nodes(root):
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if node is not root and isinstance(
+                        child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                    continue
+                stack.append(child)
+
+    def expr_traced(node):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and sub.attr in _HOST_ATTRS:
+                return False  # .shape chains are host-side
+            if isinstance(sub, ast.Call):
+                d = _dotted(sub.func, aliases)
+                if d is not None:
+                    if d.startswith(_TRACED_ROOTS):
+                        return True
+                    if d in ("len", "int", "float", "bool"):
+                        return False
+            if isinstance(sub, ast.Name) and sub.id in traced:
+                return True
+        return False
+
+    # Two passes so a use-before-def ordering in the source (rare) still
+    # converges for the common single-assignment case.
+    for _ in range(2):
+        for node in own_nodes(fn):
+            targets = ()
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = (node.target,)
+                value = node.value
+            if value is None or not expr_traced(value):
+                continue
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        traced.add(sub.id)
+    return traced
+
+
+def check_module(mod):
+    aliases = _import_aliases(mod.tree)
+    reach = _Reach(mod.tree, aliases)
+    findings = []
+
+    def emit(rule_id, node, message):
+        findings.append(
+            mod.finding(rule_id, RULES[rule_id].severity, node, message))
+
+    # -- whole-module rules (host code included) ------------------------
+    loop_depth = 0
+
+    def walk(node):
+        nonlocal loop_depth
+        is_loop = isinstance(node, (ast.For, ast.While, ast.AsyncFor))
+        if is_loop:
+            loop_depth += 1
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func, aliases)
+            if d in ("jax.debug.print", "jax.debug.breakpoint"):
+                emit("J401", node, f"{d} left in code")
+            if d == "jax.block_until_ready" and loop_depth:
+                emit("J402", node,
+                     "jax.block_until_ready inside a loop body")
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready"
+                    and d != "jax.block_until_ready" and loop_depth):
+                emit("J402", node, ".block_until_ready() inside a loop body")
+            if d in _JIT_WRAPPERS and d.endswith(".jit") and loop_depth:
+                emit("J203", node, "jax.jit inside a loop body")
+            is_jit_call = d in _JIT_WRAPPERS or (
+                d in ("functools.partial", "partial") and node.args
+                and _dotted(node.args[0], aliases) in _JIT_WRAPPERS)
+            if is_jit_call:
+                for kw in node.keywords:
+                    if kw.arg in ("static_argnums", "static_argnames") \
+                            and isinstance(kw.value,
+                                           (ast.List, ast.Set, ast.Dict)):
+                        emit("J201", kw.value,
+                             f"{kw.arg} should be a tuple, not a "
+                             f"{type(kw.value).__name__.lower()} literal")
+            if d == "jax.numpy.array" or (d or "").startswith("jax.numpy."):
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and _is_f64(kw.value, aliases):
+                        emit("J301", kw.value,
+                             "explicit float64 dtype in a jnp call")
+        if isinstance(node, (ast.For, ast.AsyncFor)) \
+                and _is_set_expr(node.iter, aliases):
+            emit("J202", node.iter, "iterating a set (unordered); use "
+                 "sorted(...) for a deterministic schedule")
+        if isinstance(node, ast.comprehension) \
+                and _is_set_expr(node.iter, aliases):
+            emit("J202", node.iter, "comprehension over a set (unordered);"
+                 " use sorted(...)")
+        if isinstance(node, ast.Attribute) \
+                and _dotted(node, aliases) == "jax.numpy.float64":
+            emit("J301", node, "jnp.float64 is float32 when x64 is off")
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+        if is_loop:
+            loop_depth -= 1
+
+    walk(mod.tree)
+
+    # -- jit-reachable-only rules --------------------------------------
+    for fn in reach.reachable:
+        traced = _traced_names(fn, aliases)
+
+        def own_walk(root):
+            stack = list(ast.iter_child_nodes(root))
+            while stack:
+                node = stack.pop()
+                yield node
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    continue  # nested defs are visited as their own fn
+                stack.extend(ast.iter_child_nodes(node))
+
+        def uses_traced(node):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Attribute) \
+                        and sub.attr in _HOST_ATTRS:
+                    return False
+                if isinstance(sub, ast.Name) and sub.id in traced:
+                    return True
+            return False
+
+        for node in own_walk(fn):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func, aliases)
+                if d in ("float", "int", "bool") and node.args \
+                        and uses_traced(node.args[0]):
+                    emit("J101", node,
+                         f"{d}() on a traced value in jit-reachable "
+                         f"function {fn.name!r}")
+                elif d in ("numpy.asarray", "numpy.array") and node.args \
+                        and uses_traced(node.args[0]):
+                    emit("J103", node,
+                         f"{d.replace('numpy', 'np')} on a traced value "
+                         f"in jit-reachable function {fn.name!r}")
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item" and not node.args:
+                    emit("J102", node,
+                         f".item() in jit-reachable function {fn.name!r}")
+            elif isinstance(node, (ast.If, ast.While)) \
+                    and uses_traced(node.test):
+                kw = "while" if isinstance(node, ast.While) else "if"
+                emit("J104", node,
+                     f"Python `{kw}` on a traced value in jit-reachable "
+                     f"function {fn.name!r} (use jnp.where/lax.cond)")
+    return findings
+
+
+def _is_f64(node, aliases):
+    if isinstance(node, ast.Constant) and node.value in (
+            "float64", "f8", "double"):
+        return True
+    d = _dotted(node, aliases)
+    return d in ("numpy.float64", "jax.numpy.float64", "float64")

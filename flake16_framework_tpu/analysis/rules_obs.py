@@ -1,0 +1,154 @@
+"""Rule pack 3 — telemetry schema drift (O-rules).
+
+The drift lint that lived in tools/check_telemetry_schema.py, folded into
+the f16lint engine so ``python -m flake16_framework_tpu lint`` is the one
+static-analysis entry point (the tool remains as a thin shim). Two layers:
+
+- static (check_module): emitters must only speak the declared wire
+  schema — an ``obs.event("kind", ...)`` whose literal kind is missing
+  from schema.EVENT_FIELDS is exactly the drift the old tool could only
+  catch after a run produced a bad document (O102); span names follow
+  the ``stage.detail`` lowercase convention the report renderer sorts
+  and columnizes (O103).
+- documents (check_docs / check_paths): validate emitted events.jsonl /
+  manifest.json / ``report --json`` / ``lint --json`` captures against
+  obs/schema.py (O101). Not part of the default package lint — on-disk
+  runs are per-machine state, not source — but reachable via
+  ``lint --telemetry PATH`` and the shim.
+"""
+
+import ast
+import json
+import os
+import re
+
+from flake16_framework_tpu.analysis.engine import (
+    ERROR, WARNING, Finding, RuleInfo,
+)
+from flake16_framework_tpu.obs import schema
+
+RULES = {r.id: r for r in (
+    RuleInfo("O101", ERROR,
+             "emitted telemetry document violates the wire schema"
+             " (obs/schema.py)"),
+    RuleInfo("O102", ERROR,
+             "obs.event() with a kind not declared in schema.EVENT_FIELDS"
+             " — undeclared wire-schema drift"),
+    RuleInfo("O103", WARNING,
+             "span name does not match the lowercase dotted convention"
+             " ([a-z0-9_.]+)"),
+)}
+
+_SPAN_NAME_RE = re.compile(r"^[a-z0-9_.]+$")
+
+
+def check_module(mod):
+    findings = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        if node.func.attr == "event" and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            kind = node.args[0].value
+            if kind not in schema.EVENT_FIELDS:
+                findings.append(mod.finding(
+                    "O102", RULES["O102"].severity, node,
+                    f"event kind {kind!r} is not declared in "
+                    f"schema.EVENT_FIELDS (known: "
+                    f"{sorted(schema.EVENT_FIELDS)})"))
+        elif node.func.attr == "span" and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            name = node.args[0].value
+            if not _SPAN_NAME_RE.match(name):
+                findings.append(mod.finding(
+                    "O103", RULES["O103"].severity, node,
+                    f"span name {name!r} does not match "
+                    f"{_SPAN_NAME_RE.pattern!r}"))
+    return findings
+
+
+# -- emitted-document validation (the old tool's body) ------------------
+
+
+def check_events_file(path):
+    """(n_events, problems) for one events.jsonl file."""
+    problems = []
+    n = 0
+    with open(path) as fd:
+        for lineno, line in enumerate(fd, start=1):
+            if not line.strip():
+                continue
+            n += 1
+            try:
+                ev = json.loads(line)
+            except ValueError as e:
+                problems.append(f"{path}:{lineno}: not JSON ({e})")
+                continue
+            problems += [f"{path}:{lineno}: {p}"
+                         for p in schema.validate_event(ev)]
+    return n, problems
+
+
+def check_json_file(path):
+    """Problems for one JSON document — a manifest, a ``report --json``
+    capture, or a ``lint --json`` capture, dispatched on its ``schema``."""
+    try:
+        with open(path) as fd:
+            obj = json.load(fd)
+    except ValueError as e:
+        return [f"{path}: not JSON ({e})"]
+    if isinstance(obj, dict) and obj.get("schema") == schema.REPORT_SCHEMA:
+        probs = schema.validate_report(obj)
+    elif isinstance(obj, dict) and obj.get("schema") == schema.LINT_SCHEMA:
+        probs = schema.validate_lint_report(obj)
+    else:
+        probs = schema.validate_manifest(obj)
+    return [f"{path}: {p}" for p in probs]
+
+
+def check_run_dir(path):
+    problems = []
+    n_events = 0
+    events = os.path.join(path, schema.EVENTS_FILE)
+    manifest = os.path.join(path, schema.MANIFEST_FILE)
+    if os.path.isfile(events):
+        n_events, probs = check_events_file(events)
+        problems += probs
+    else:
+        problems.append(f"{path}: no {schema.EVENTS_FILE}")
+    if os.path.isfile(manifest):
+        problems += check_json_file(manifest)
+    else:
+        problems.append(f"{path}: no {schema.MANIFEST_FILE}")
+    return n_events, problems
+
+
+def check_paths(paths):
+    """(n_events_validated, problems) across files and run directories —
+    the exact contract tools/check_telemetry_schema.py always exported
+    (tests/test_obs.py pins it)."""
+    n_total, problems = 0, []
+    for path in paths:
+        if os.path.isdir(path):
+            n, probs = check_run_dir(path)
+            n_total += n
+            problems += probs
+        elif path.endswith(".jsonl"):
+            n, probs = check_events_file(path)
+            n_total += n
+            problems += probs
+        else:
+            problems += check_json_file(path)
+    return n_total, problems
+
+
+def check_docs(paths):
+    """Document problems as O101 findings (the ``lint --telemetry PATH``
+    path). Each problem string already carries its own path context."""
+    _, problems = check_paths(paths)
+    return [Finding("O101", RULES["O101"].severity, str(p).split(":")[0],
+                    0, 0, p, snippet=p)
+            for p in problems]

@@ -261,7 +261,10 @@ def forest_shap_class0(forest, x, *, sample_chunk=None, impl="auto",
             c = sub.feature.shape[0]
             phi = forest_shap_class0(sub, x, sample_chunk=sample_chunk,
                                      impl=impl, _trim=False) * c
-            phi.block_until_ready()
+            # Deliberate per-chunk block: tree_chunk exists to BOUND single
+            # dispatch duration (device-fault envelope), so chunks must not
+            # pipeline into one long in-flight tail.
+            phi.block_until_ready()  # f16lint: disable=J402
             acc = phi if acc is None else acc + phi
         return acc / t_total
     auto = impl == "auto"

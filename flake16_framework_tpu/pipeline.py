@@ -189,7 +189,9 @@ def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
     def _mark(stage, t0, *sync):
         if timings is not None:
             for v in sync:
-                jax.block_until_ready(v)
+                # timed mode only: the sync IS the instrument (per-stage
+                # wall attribution); the default path never reaches this.
+                jax.block_until_ready(v)  # f16lint: disable=J402
             timings[stage] = round(time.time() - t0, 4)
         return time.time()
 
@@ -257,7 +259,9 @@ def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
                                   n_trees=min(dc, spec.n_trees - lo),
                                   tree_keys=tks[lo:lo + dc], edges=edges)
                     part = trees.fit_forest_hist(xs, ys, ws, kf, **sub_kw)
-                    jax.block_until_ready(part)
+                    # Deliberate per-chunk block: fit_dispatch_trees exists
+                    # to bound single dispatch duration (fault envelope).
+                    jax.block_until_ready(part)  # f16lint: disable=J402
                     parts.append(part)
                 forest = trees.concat_trees(parts)
             else:

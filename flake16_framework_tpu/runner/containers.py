@@ -180,7 +180,9 @@ def enumerate_containers(run_modes, subjects=None):
     """All (name, commands) pairs: {proj} x {mode} x {run_n}
     (reference iter_containers experiment.py:184-188)."""
     for subject in (subjects if subjects is not None else iter_subjects()):
-        for mode in set(run_modes):
+        # sorted: set iteration order is hash-seed-dependent, and container
+        # launch order should be reproducible run to run (f16lint J202).
+        for mode in sorted(set(run_modes)):
             for run_n in range(N_RUNS[mode]):
                 yield f"{subject.name}_{mode}_{run_n}", subject.commands
 

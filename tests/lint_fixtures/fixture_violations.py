@@ -1,0 +1,61 @@
+"""Seeded f16lint violations — at least one per AST rule id.
+
+NEVER imported (no test collects it as code); tests/test_lint.py parses
+it through the engine and asserts each rule fires at the marked line.
+The imports below exist so the alias resolver sees realistic bindings.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flake16_framework_tpu import obs
+
+
+@jax.jit
+def host_sync_casts(x):
+    z = jnp.sum(x)
+    a = float(z)                  # expect J101
+    b = z.item()                  # expect J102
+    c = np.asarray(z)             # expect J103
+    if z > 0:                     # expect J104
+        a = a + 1.0
+    return a, b, c
+
+
+@functools.partial(jax.jit, static_argnums=[0])   # expect J201
+def static_list_partial(n, x):
+    return x * n
+
+
+def retrace_hazards(fs):
+    outs = []
+    for f in {1, 2, 3}:                            # expect J202
+        outs.append(jax.jit(lambda x: x + f)(fs))  # expect J203
+    return outs
+
+
+def dtype_drift(x):
+    return jnp.asarray(x, dtype="float64")         # expect J301
+
+
+def debug_leftovers(xs):
+    jax.debug.print("x = {}", xs)                  # expect J401
+    for x in xs:
+        jax.block_until_ready(x)                   # expect J402
+    return xs
+
+
+def telemetry_drift():
+    with obs.span("Bad Span Name"):                # expect O103
+        obs.event("made_up_kind", x=1)             # expect O102
+
+
+def suppressed_examples(xs):
+    """Inline suppressions — test_lint.py asserts these do NOT surface."""
+    jax.debug.print("kept = {}", xs)  # f16lint: disable=J401
+    for x in xs:
+        jax.block_until_ready(x)  # f16lint: disable=J402
+    return xs

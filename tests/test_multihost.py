@@ -11,11 +11,26 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# XLA's CPU client refuses cross-process computations outright; the child
+# tracebacks reach the parent's stderr, which we capture below. Skipping on
+# this signature keeps the test meaningful wherever a real multiprocess
+# backend (TPU, GPU) exists while not failing CPU-only CI.
+_CPU_BACKEND_LIMIT = "Multiprocess computations aren't implemented on the CPU"
 
 
 def test_multihost_dryrun_small():
     import signal
+
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # Skip before paying the ~15s two-child launch: the outcome is
+        # foregone (see _CPU_BACKEND_LIMIT), and tier-1 runs near its
+        # wall-clock budget.
+        pytest.skip("backend cannot run jax.distributed multiprocess "
+                    "computations (XLA CPU client limitation)")
 
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # children set their own JAX env
@@ -35,6 +50,9 @@ def test_multihost_dryrun_small():
         os.killpg(p.pid, signal.SIGKILL)
         p.wait()
         raise
+    if p.returncode != 0 and _CPU_BACKEND_LIMIT in err:
+        pytest.skip("backend cannot run jax.distributed multiprocess "
+                    "computations (XLA CPU client limitation)")
     assert p.returncode == 0, (out[-500:], err[-800:])
     line = json.loads(out.strip().splitlines()[-1])
     assert line["multihost_dryrun_ok"] is True

@@ -1,94 +1,34 @@
-"""Telemetry schema lint: validate emitted documents against the schema
-in flake16_framework_tpu/obs/schema.py (PROFILE.md "Telemetry").
+"""Telemetry schema lint — thin shim over the f16lint O-rule pack.
+
+The document-validation body moved into
+``flake16_framework_tpu/analysis/rules_obs.py`` when the drift lint was
+folded into the unified static-analysis engine (ISSUE 2 satellite):
+``python -m flake16_framework_tpu lint --telemetry PATH`` is the
+canonical entry point now. This script keeps its historical CLI (and the
+``check_paths`` import contract tests/test_obs.py pins):
 
     python tools/check_telemetry_schema.py [PATH ...]
 
 Each PATH may be a run directory (validates its events.jsonl +
-manifest.json), a .jsonl event file, or a JSON file (a manifest or a
-``report --json`` capture — dispatched on the object's ``schema``/shape).
-With no PATH, every run under the default telemetry root is checked
-(exits 0 with a note when none exist — a fresh checkout is not a lint
-failure).
-
-Runnable inside tests (tests/test_obs.py imports check_paths), so an
-emitter drifting from the documented schema — a new undeclared event
-kind, a dropped required field, a type change — fails tier-1, not a
-future operator's report.
+manifest.json), a .jsonl event file, or a JSON file (manifest, ``report
+--json``, or ``lint --json`` capture — dispatched on the object's
+``schema``). With no PATH, every run under the default telemetry root is
+checked (exits 0 with a note when none exist).
 """
 
-import json
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from flake16_framework_tpu.analysis.rules_obs import (  # noqa: E402,F401
+    check_events_file,
+    check_json_file,
+    check_paths,
+    check_run_dir,
+)
 from flake16_framework_tpu.obs import core, schema  # noqa: E402
-
-
-def check_events_file(path):
-    problems = []
-    n = 0
-    with open(path) as fd:
-        for lineno, line in enumerate(fd, start=1):
-            if not line.strip():
-                continue
-            n += 1
-            try:
-                ev = json.loads(line)
-            except ValueError as e:
-                problems.append(f"{path}:{lineno}: not JSON ({e})")
-                continue
-            problems += [f"{path}:{lineno}: {p}"
-                         for p in schema.validate_event(ev)]
-    return n, problems
-
-
-def check_json_file(path):
-    try:
-        with open(path) as fd:
-            obj = json.load(fd)
-    except ValueError as e:
-        return [f"{path}: not JSON ({e})"]
-    if isinstance(obj, dict) and obj.get("schema") == schema.REPORT_SCHEMA:
-        probs = schema.validate_report(obj)
-    else:
-        probs = schema.validate_manifest(obj)
-    return [f"{path}: {p}" for p in probs]
-
-
-def check_run_dir(path):
-    problems = []
-    n_events = 0
-    events = os.path.join(path, schema.EVENTS_FILE)
-    manifest = os.path.join(path, schema.MANIFEST_FILE)
-    if os.path.isfile(events):
-        n_events, probs = check_events_file(events)
-        problems += probs
-    else:
-        problems.append(f"{path}: no {schema.EVENTS_FILE}")
-    if os.path.isfile(manifest):
-        problems += check_json_file(manifest)
-    else:
-        problems.append(f"{path}: no {schema.MANIFEST_FILE}")
-    return n_events, problems
-
-
-def check_paths(paths):
-    """(n_events_validated, problems) across files and run directories."""
-    n_total, problems = 0, []
-    for path in paths:
-        if os.path.isdir(path):
-            n, probs = check_run_dir(path)
-            n_total += n
-            problems += probs
-        elif path.endswith(".jsonl"):
-            n, probs = check_events_file(path)
-            n_total += n
-            problems += probs
-        else:
-            problems += check_json_file(path)
-    return n_total, problems
 
 
 def main(argv):

@@ -1,0 +1,53 @@
+"""Regenerate an f16lint baseline file from the current findings.
+
+    python tools/gen_lint_baseline.py [PATHS...] [--out FILE]
+
+Runs the full f16lint rule set (inline suppressions still apply — a
+baseline records what inline comments do NOT already silence) over PATHS
+(default: the package, like the CI gate) and writes the finding
+fingerprints to FILE (default tools/lint_baseline.json). Re-linting with
+``--baseline FILE`` then exits 0 until NEW findings appear — the
+ratchet workflow for adopting a rule on a codebase with existing debt
+(PROFILE.md "Static analysis" > baseline workflow).
+
+The repo itself ships with zero findings and no checked-in baseline (the
+dogfood bar: ISSUE 2 acceptance); this tool exists for downstream forks
+and for staging new rules.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from flake16_framework_tpu.analysis import engine as eng  # noqa: E402
+from flake16_framework_tpu.analysis.cli import run_lint  # noqa: E402
+
+DEFAULT_OUT = os.path.join(REPO, "tools", "lint_baseline.json")
+
+
+def main(argv):
+    out_file = DEFAULT_OUT
+    paths = []
+    it = iter(argv)
+    for a in it:
+        if a == "--out":
+            out_file = next(it, None)
+            if out_file is None:
+                raise ValueError("--out needs a file argument")
+        elif a.startswith("--"):
+            raise ValueError(f"Unrecognized option {a!r}")
+        else:
+            paths.append(a)
+
+    result = run_lint(paths or None)
+    eng.save_baseline(out_file, result.findings)
+    print(f"wrote {len(result.findings)} fingerprint(s) to {out_file}")
+    for f in result.findings:
+        print(f"  {f.fingerprint}  {f.render()}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
