@@ -37,6 +37,7 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tests"))
 
 from flake16_framework_tpu import obs  # noqa: E402  (needs REPO on sys.path)
+from flake16_framework_tpu.resilience import faults  # noqa: E402
 
 N_TESTS = int(os.environ.get("BENCH_N_TESTS", "2000"))
 N_TREES = int(os.environ.get("BENCH_N_TREES", "100"))
@@ -610,12 +611,18 @@ def main():
         probe_ok, probe_err = probe()
         if not probe_ok:
             detail["tpu_probe"] = probe_err  # wedged tunnel vs cpu-only etc.
+            # The forensics the resilience layer standardizes: which fault
+            # class the failure text maps to (resilience/faults.py) — "no
+            # relay listener" reads relay-down, a timeout transient, etc.
+            detail["tpu_probe_class"] = faults.classify_message(
+                probe_err or "")
     tpu_stages = {}
     if probe_ok:
         result, err, stages = run_worker(n, t)
         tpu_stages.update(stages)
         if result is None:
             detail["tpu_attempt_1"] = err
+            detail["tpu_attempt_1_class"] = faults.classify_message(err or "")
             # Faults can be transient — but a worker killed mid-dispatch can
             # leave the tunnel claim wedged, in which case a blind retry just
             # burns another WORKER_TIMEOUT_S. Re-probe first.
@@ -625,8 +632,12 @@ def main():
                 tpu_stages.update(stages)
                 if result is None:
                     detail["tpu_attempt_2"] = err
+                    detail["tpu_attempt_2_class"] = faults.classify_message(
+                        err or "")
             else:
                 detail["tpu_reprobe"] = probe_err
+                detail["tpu_reprobe_class"] = faults.classify_message(
+                    probe_err or "")
 
     if result is None and os.environ.get("BENCH_DEVICE") != "cpu":
         # The recovery watcher (tools/recovery_watch.py) may have landed a

@@ -18,6 +18,17 @@ plus two extension verbs the reference lacks:
         # f16lint: JAX/TPU-hygiene static analysis + 216-config grid
         # pre-flight (analysis/); exit 1 on unsuppressed findings
 
+Fault tolerance (resilience/): ``scores`` dispatches every config through
+the resilience guard — transient device faults retry with backoff, OOMs
+retry at halved chunk bounds, and a config that exhausts its attempts is
+QUARANTINED: the sweep finishes the rest, persists everything, writes
+``<scores.pkl>.quarantine.json`` (fault class + attempt history), and
+exits with code 23 (resilience.QUARANTINE_EXIT_CODE) listing the
+quarantined configs. Re-running ``scores`` re-attempts exactly those
+configs (they are absent from the pickle, so the per-config resume picks
+them up). ``F16_FAULT_INJECT=<config>:<attempt>:<class>[;...]`` injects
+deterministic faults for drills (see PROFILE.md "Fault tolerance").
+
 Unknown/missing verbs raise ValueError like the reference.
 """
 

@@ -62,6 +62,10 @@ RULES = {r.id: r for r in (
     RuleInfo("J402", WARNING,
              "block_until_ready inside a loop body — serializes the"
              " dispatch pipeline (one tunnel round-trip per iteration)"),
+    RuleInfo("J501", WARNING,
+             "broad except around a device dispatch without routing the"
+             " failure through the resilience layer — faults vanish"
+             " unclassified instead of retrying/degrading/quarantining"),
 )}
 
 # Call roots whose results are traced arrays (after alias resolution).
@@ -78,6 +82,18 @@ _JIT_WRAPPERS = {
 }
 # Attribute access that turns a traced value back into a host value.
 _HOST_ATTRS = {"shape", "dtype", "ndim", "size"}
+
+# J501: calls where device faults of a dispatch actually surface — a
+# broad except around one of these is handling DEVICE failures, and must
+# hand them to the resilience layer (classify / guard / ladder) rather
+# than swallow them unclassified. ``.block_until_ready()`` attribute
+# calls count too (matched structurally below).
+_DISPATCH_MARKERS = {"jax.block_until_ready", "jax.device_get"}
+# Any call resolving under this package counts as "routed": the
+# classifier (faults.classify*), the guard, a ladder step, ...
+_RESILIENCE_ROOT = "flake16_framework_tpu.resilience"
+_BROAD_EXCEPTS = {"Exception", "BaseException", "builtins.Exception",
+                  "builtins.BaseException"}
 
 
 def _import_aliases(tree):
@@ -286,6 +302,41 @@ def check_module(mod):
             loop_depth -= 1
 
     walk(mod.tree)
+
+    # -- J501: unguarded broad excepts around device dispatches ---------
+
+    def has_dispatch(stmts):
+        for stmt in stmts:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if _dotted(sub.func, aliases) in _DISPATCH_MARKERS:
+                    return True
+                if isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "block_until_ready":
+                    return True
+        return False
+
+    def routes_resilience(handler):
+        for sub in ast.walk(handler):
+            if isinstance(sub, ast.Call):
+                d = _dotted(sub.func, aliases)
+                if d and (d == _RESILIENCE_ROOT
+                          or d.startswith(_RESILIENCE_ROOT + ".")):
+                    return True
+        return False
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Try) or not has_dispatch(node.body):
+            continue
+        for h in node.handlers:
+            broad = h.type is None \
+                or _dotted(h.type, aliases) in _BROAD_EXCEPTS
+            if broad and not routes_resilience(h):
+                emit("J501", h,
+                     "except Exception around a device dispatch must route"
+                     " the failure through flake16_framework_tpu.resilience"
+                     " (classify / guard / ladder), not swallow it")
 
     # -- jit-reachable-only rules --------------------------------------
     for fn in reach.reachable:

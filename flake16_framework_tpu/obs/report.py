@@ -71,6 +71,7 @@ def summarize(manifest, events):
     counters = {}
     gauges = {}
     heartbeats = {"n": 0, "last_ts": None}
+    faults = {"n": 0, "by_class": {}, "by_action": {}, "quarantined": []}
     ts_all = [e["ts"] for e in events if isinstance(e.get("ts"), (int, float))]
     for ev in events:
         kind = ev.get("kind")
@@ -93,6 +94,14 @@ def summarize(manifest, events):
         elif kind == "heartbeat":
             heartbeats["n"] += 1
             heartbeats["last_ts"] = ev.get("ts")
+        elif kind == "fault":
+            faults["n"] += 1
+            fc = ev.get("fault_class", "?")
+            act = ev.get("action", "?")
+            faults["by_class"][fc] = faults["by_class"].get(fc, 0) + 1
+            faults["by_action"][act] = faults["by_action"].get(act, 0) + 1
+            if act == "quarantine":
+                faults["quarantined"].append(ev.get("config", "?"))
 
     started = manifest.get("started_ts")
     t0 = started if isinstance(started, (int, float)) else (
@@ -130,6 +139,7 @@ def summarize(manifest, events):
         "counters": counters,
         "throughput_per_s": throughput,
         "gauges": gauges,
+        "faults": faults,
         "heartbeats": heartbeats,
         "n_events": len(events),
     }
@@ -187,6 +197,19 @@ def render(report):
             g = report["gauges"][name]
             out.append(f"{name:<28}{g['peak']:>12.1f}"
                        f"{g.get('last', g['peak']):>12.1f}")
+        out.append("")
+
+    faults = report.get("faults") or {}
+    if faults.get("n"):
+        by_class = ", ".join(f"{k}={v}" for k, v in
+                             sorted(faults["by_class"].items()))
+        by_action = ", ".join(f"{k}={v}" for k, v in
+                              sorted(faults["by_action"].items()))
+        out.append(f"faults: {faults['n']} ({by_class})")
+        out.append(f"  actions: {by_action}")
+        if faults.get("quarantined"):
+            out.append("  quarantined: "
+                       + ", ".join(str(c) for c in faults["quarantined"]))
         out.append("")
 
     hb = report["heartbeats"]

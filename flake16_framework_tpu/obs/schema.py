@@ -49,6 +49,12 @@ EVENT_FIELDS = {
     "profile": {"trace_dir": str},
     # Mirror of a bench stage record (bench.py stage ledger schema).
     "stage": {"stage": str},
+    # A resilience-layer transition (resilience/: the dispatch guard and
+    # the degradation ladder). ``fault_class`` is one of faults.
+    # FAULT_CLASSES; ``action`` is retry | recovered | degrade | abandon |
+    # quarantine | ledger-reset; ``attempt`` is the 1-based attempt the
+    # transition happened on (0 where no attempt applies).
+    "fault": {"fault_class": str, "action": str, "attempt": int},
 }
 
 MANIFEST_FIELDS = {
@@ -58,7 +64,7 @@ MANIFEST_FIELDS = {
 
 REPORT_FIELDS = {
     "schema": str, "run": str, "wall_s": _NUM, "spans": dict,
-    "counters": dict, "gauges": dict,
+    "counters": dict, "gauges": dict, "faults": dict,
 }
 
 # Required numeric per-span stats in a report's ``spans`` values — what the

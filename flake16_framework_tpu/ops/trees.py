@@ -50,6 +50,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from flake16_framework_tpu.resilience import ladder as _res_ladder
+
 # sklearn's FEATURE_THRESHOLD: two values closer than this are "equal" for
 # split-candidate purposes.
 FEATURE_EPS = 1e-7
@@ -856,7 +858,14 @@ def fit_forest_hist(x, y, w, key, *, n_trees, bootstrap, random_splits,
 def _map_trees(one, keys, n_trees, tree_chunk):
     """vmap ``one`` over per-tree keys, optionally in sequential chunks of
     ``tree_chunk`` via ``lax.map`` (bounds the concurrent per-tree workspace;
-    results are identical since keys don't depend on chunking)."""
+    results are identical since keys don't depend on chunking).
+
+    The degradation ladder's halvings apply here as a backstop rung
+    (resilience/ladder.py): chunk-invariant, so a degraded re-trace grows
+    identical trees in a smaller workspace. Trace-time only — callers
+    inside a cached jit keep their compiled chunking until re-trace; the
+    sweep's per-dispatch bounds (_dispatch_bounds) are the live rung."""
+    tree_chunk = _res_ladder.halved(tree_chunk)
     if tree_chunk is None or tree_chunk >= n_trees:
         return jax.vmap(one)(keys)
     pad = (-n_trees) % tree_chunk

@@ -31,6 +31,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from flake16_framework_tpu.ops.trees import slice_trees, trim_nodes
+from flake16_framework_tpu.resilience import ladder as _ladder
 
 
 def extract_paths(feature, threshold, left, right, value, max_depth):
@@ -247,6 +248,12 @@ def forest_shap_class0(forest, x, *, sample_chunk=None, impl="auto",
     # the top level — per-chunk re-trims would give chunks different M
     # buckets and recompile the SHAP program per chunk.
     if _trim:
+        # Degradation ladder (resilience/ladder.py): after an OOM /
+        # envelope-overrun the halved bounds shrink the live workspace and
+        # the single-dispatch duration. Top level only — the tree_chunk
+        # recursion below passes already-halved bounds with _trim=False.
+        sample_chunk = _ladder.halved(sample_chunk)
+        tree_chunk = _ladder.halved(tree_chunk)
         m = forest.feature.shape[-1]
         n_used = int(jax.device_get(jnp.max(forest.n_nodes)))
         m_trim = min(m, max(128, -(-n_used // 128) * 128))
@@ -292,7 +299,10 @@ def forest_shap_class0(forest, x, *, sample_chunk=None, impl="auto",
                 raise
             import sys
 
-            _PALLAS_AUTO_BROKEN[0] = True
+            # The pallas->xla rung of the degradation ladder: classifies
+            # the failure, emits the fault/degrade obs event, and sets the
+            # sticky per-process flag (resilience/ladder.py).
+            _ladder.mark_pallas_broken(e)
             print(f"treeshap: pallas kernel failed on "
                   f"{jax.default_backend()} ({type(e).__name__}: "
                   f"{str(e)[:200]}); auto-falling back to impl='xla'",
@@ -303,11 +313,30 @@ def forest_shap_class0(forest, x, *, sample_chunk=None, impl="auto",
     return _xla_forest_shap(forest, x, depth=depth, sample_chunk=sample_chunk)
 
 
-# One sticky flag per process: after an auto-mode kernel failure, every
-# later auto call (including the remaining chunks of a tree_chunk loop)
-# goes straight to the XLA formulation instead of re-running the failed
-# Mosaic compile per chunk.
-_PALLAS_AUTO_BROKEN = [False]
+class _PallasBrokenProxy:
+    """Back-compat view of the old sticky ``_PALLAS_AUTO_BROKEN = [False]``
+    flag, now owned by the degradation ladder (resilience/ladder.py
+    ``pallas_broken``): after an auto-mode kernel failure, every later auto
+    call (including the remaining chunks of a tree_chunk loop) goes straight
+    to the XLA formulation instead of re-running the failed Mosaic compile
+    per chunk. Reads/writes of ``_PALLAS_AUTO_BROKEN[0]`` (tests, external
+    scripts) keep working and see/steer the ladder state."""
+
+    def __getitem__(self, i):
+        if i != 0:
+            raise IndexError(i)
+        return _ladder.state().pallas_broken
+
+    def __setitem__(self, i, v):
+        if i != 0:
+            raise IndexError(i)
+        _ladder.state().pallas_broken = bool(v)
+
+    def __repr__(self):
+        return f"[{_ladder.state().pallas_broken}]"
+
+
+_PALLAS_AUTO_BROKEN = _PallasBrokenProxy()
 
 
 @functools.partial(jax.jit, static_argnames=("depth", "sample_chunk"))

@@ -34,6 +34,9 @@ from flake16_framework_tpu.ops.preprocess import fit_preprocess, transform
 from flake16_framework_tpu.ops.resample import resample
 from flake16_framework_tpu.ops import trees
 from flake16_framework_tpu.parallel.folds import fold_masks, lopo_fold_masks
+from flake16_framework_tpu.resilience import (
+    guard as rguard, inject as rinject, ladder as rladder,
+)
 
 N_FOLDS = 10
 
@@ -45,10 +48,13 @@ def _auto_tree_chunk(spec, n_folds, tree_chunk, use_hist):
     ([N, node_batch] one-hots vs [F, N] sort/gather buffers), so its budget
     is correspondingly larger. ``use_hist`` must be the same predicate that
     selects the grower in ``_make_config_fns`` or the budget would be sized
-    for the wrong workspace."""
+    for the wrong workspace. Both the explicit chunk and the budget pass
+    through the degradation ladder (resilience/ladder.py): after an OOM
+    the halved budget shrinks the concurrent workspace the same way a
+    smaller chunk would — chunk-invariant, so results are unchanged."""
     if tree_chunk is not None:
-        return tree_chunk
-    budget = 320 if use_hist else 64
+        return rladder.halved(tree_chunk)
+    budget = rladder.halved(320 if use_hist else 64)
     if spec.n_trees * n_folds <= budget:
         return None
     return max(1, budget // n_folds)
@@ -333,27 +339,22 @@ def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
             return a  # full range: no slice op for XLA to copy
         return a[flo:fhi] if fold_axis == 0 else a[:, flo:fhi]
 
+    # Dispatch + block through the resilience guard, retrying ONCE on a
+    # transient device fault (the pre-ISSUE-3 run_bounded semantics, now
+    # owned by resilience/guard.py: classification, the 5 s backoff, and
+    # the obs fault events all come from the one layer). Chunks are
+    # deterministic (explicit key slices), so a retry is bit-identical;
+    # anything non-transient propagates as DispatchAbandoned — which
+    # carries the inner fault class, so the per-config guard above this
+    # (run_grid) classifies and retries/quarantines the whole fit.
+    chunk_guard = rguard.DispatchGuard(
+        policy=rguard.BackoffPolicy(max_attempts=2, base_s=5.0, factor=1.0,
+                                    jitter=0.0),
+        block=True,
+    )
+
     def run_bounded(thunk):
-        """Dispatch + block, retrying ONCE on a transient device fault.
-        Chunks are deterministic (explicit key slices), so a retry is
-        bit-identical; only the tunnel's fault signature is retried —
-        anything else propagates. A failed retry raises, aborting the whole
-        fit (no per-chunk catch exists above this), so a hard-down tunnel
-        costs one sleep + re-dispatch per process, not per chunk."""
-        try:
-            out = thunk()
-            jax.block_until_ready(out)
-            return out
-        except Exception as e:  # jaxlib runtime errors share no base class
-            # XlaRuntimeError carries the gRPC status as a message prefix;
-            # an incidental "UNAVAILABLE" elsewhere in a message is not a
-            # device fault and must propagate.
-            if not str(e).startswith("UNAVAILABLE"):
-                raise
-            time.sleep(5)
-            out = thunk()
-            jax.block_until_ready(out)
-            return out
+        return chunk_guard.call(thunk, label="fit-chunk")
 
     # timings (when given) gets per-stage walls with a block after each
     # stage — the TPU attribution instrument (PROFILE.md round 3: rf_full
@@ -477,6 +478,10 @@ class SweepEngine:
         # that went through run_config_batch on this engine) — the timing
         # provenance write_scores persists beside the pickle.
         self.amortized_configs = set()
+        # {config_keys: {"fault_class", "attempts"}} for configs that
+        # exhausted the dispatch guard's retries in run_grid — persisted
+        # by pipeline.write_scores as the quarantine sidecar.
+        self.quarantined = {}
         self._fns = {}
         self._sharded_fns = {}
         # Fold masks depend on the label vector => per flaky type
@@ -534,9 +539,17 @@ class SweepEngine:
         dispatch). One place, so the single-device and mesh paths cannot
         diverge on the gating rules."""
         dc = self.dispatch_trees
+        df = self.dispatch_folds
+        halv = rladder.state().halvings
+        if halv:
+            # OOM / envelope-overrun rungs (resilience/ladder.py): halve
+            # the dispatch bounds — introducing one where none was set —
+            # so a degraded retry runs smaller, shorter dispatches.
+            # Chunk-invariant by design: results are unchanged.
+            dc = max(1, (dc if dc is not None else n_trees) >> halv)
+            df = max(1, (df if df is not None else self.n_folds) >> halv)
         if dc is not None and n_trees <= dc:
             dc = None
-        df = self.dispatch_folds
         if df is not None and self.n_folds <= df:
             df = None
         return dc, df
@@ -771,21 +784,69 @@ class SweepEngine:
             config_list = cfg.iter_config_keys()
         todo = [tuple(k) for k in config_list if tuple(k) not in scores]
 
+        # Every config dispatch goes through the resilience guard
+        # (resilience/guard.py): transient faults retry with backoff,
+        # oom/envelope faults step the degradation ladder before the
+        # retry, and a config that exhausts its attempts is QUARANTINED —
+        # recorded in self.quarantined with its attempt history, the
+        # sweep continues with the remaining configs. Config indices for
+        # the injection plan come from the canonical iter_config_keys()
+        # order (the same order that seeds per-config RNG keys).
+        plan = rinject.plan_from_env()
+        guard = rguard.default_guard(plan=plan, block=False)
+        index_of = {k: i for i, k in enumerate(cfg.iter_config_keys())}
+
+        def run_guarded(keys):
+            """One config under the guard; None when quarantined."""
+            def thunk():
+                with rladder.device_context():
+                    return self.run_config(keys)
+            try:
+                return guard.call(thunk, config_index=index_of.get(keys),
+                                  label="/".join(keys))
+            except rguard.DispatchAbandoned as e:
+                self.quarantined[keys] = {"fault_class": e.fault_class,
+                                          "attempts": e.attempts}
+                obs.event("fault", fault_class=e.fault_class,
+                          action="quarantine", attempt=len(e.attempts),
+                          config="/".join(keys))
+                return None
+
         b = batch_size if batch_size is not None else (
             self.mesh.devices.size if self.mesh is not None else 1)
+        if plan is not None:
+            # Injection targets (config k, attempt j); the batch path runs
+            # many configs per dispatch, so the fault drill forces the
+            # per-config path to keep config granularity deterministic.
+            b = 1
         if self.mesh is None or b <= 1:
             for i, keys in enumerate(todo):
-                scores[keys] = self.run_config(keys)
+                res = run_guarded(keys)
+                if res is not None:
+                    scores[keys] = res
                 if progress is not None:
                     progress(i + 1, len(todo), keys, scores)
             return scores
 
         done = 0
         for batch in iter_family_batches(todo, b):
-            results = (self.run_config_batch(batch) if len(batch) > 1
-                       else [self.run_config(batch[0])])
+            if len(batch) > 1:
+                def batch_thunk(batch=batch):
+                    with rladder.device_context():
+                        return self.run_config_batch(batch)
+                try:
+                    results = guard.call(
+                        batch_thunk,
+                        label=f"batch/{batch[0][1]}/{batch[0][4]}")
+                except rguard.DispatchAbandoned:
+                    # Salvage per-config: one bad config (or one flaky
+                    # batch dispatch) must not quarantine its batch-mates.
+                    results = [run_guarded(k) for k in batch]
+            else:
+                results = [run_guarded(batch[0])]
             for keys, res in zip(batch, results):
-                scores[keys] = res
+                if res is not None:
+                    scores[keys] = res
                 done += 1
                 if progress is not None:
                     progress(done, len(todo), keys, scores)

@@ -28,10 +28,52 @@ from flake16_framework_tpu.ops import trees, treeshap
 from flake16_framework_tpu.ops.preprocess import fit_preprocess, transform
 from flake16_framework_tpu.ops.resample import resample
 from flake16_framework_tpu.parallel.sweep import SweepEngine
+from flake16_framework_tpu.resilience import faults
+from flake16_framework_tpu.resilience import quarantine as rquarantine
 
 
 def _load_arrays(tests_file):
     return tests_to_arrays(load_tests(tests_file))
+
+
+def _load_ledger(out_file, warn_out=sys.stderr):
+    """Crash-consistent resume: load the checkpoint ledger, tolerating a
+    truncated/corrupt partial pickle (a kill mid-_dump leaves only the
+    .tmp torn, but a pre-ISSUE-3 artifact or a torn filesystem may still
+    hand us garbage). A bad ledger WARNS and restarts the affected
+    configs rather than aborting the sweep; entries that do not carry the
+    reference 4-element value schema are dropped individually."""
+    if not os.path.exists(out_file):
+        return {}
+    try:
+        with open(out_file, "rb") as fd:
+            ledger = pickle.load(fd)
+    except (OSError, EOFError, pickle.UnpicklingError, AttributeError,
+            ImportError, IndexError, ValueError) as e:
+        warn_out.write(
+            f"warning: checkpoint ledger {out_file} unreadable "
+            f"({type(e).__name__}: {e}); restarting all configs\n")
+        obs.event("fault", fault_class=faults.DETERMINISTIC,
+                  action="ledger-reset", attempt=0,
+                  error=str(e)[:200])
+        return {}
+    if not isinstance(ledger, dict):
+        warn_out.write(
+            f"warning: checkpoint ledger {out_file} is not a dict "
+            f"({type(ledger).__name__}); restarting all configs\n")
+        obs.event("fault", fault_class=faults.DETERMINISTIC,
+                  action="ledger-reset", attempt=0, error="not a dict")
+        return {}
+    bad = [k for k, v in ledger.items()
+           if not (isinstance(v, (list, tuple)) and len(v) == 4)]
+    for k in bad:
+        del ledger[k]
+    if bad:
+        warn_out.write(
+            f"warning: dropped {len(bad)} malformed ledger entr"
+            f"{'y' if len(bad) == 1 else 'ies'} from {out_file}; "
+            f"those configs restart\n")
+    return ledger
 
 
 def write_scores(tests_file=TESTS_FILE, out_file=None, *,
@@ -64,10 +106,7 @@ def write_scores(tests_file=TESTS_FILE, out_file=None, *,
         fused=fused,
     )
 
-    ledger = {}
-    if os.path.exists(out_file):
-        with open(out_file, "rb") as fd:
-            ledger = pickle.load(fd)
+    ledger = _load_ledger(out_file)
 
     t0 = time.time()
 
@@ -98,6 +137,24 @@ def write_scores(tests_file=TESTS_FILE, out_file=None, *,
     _write_timing_meta(out_file, engine.amortized_configs,
                        engine.fused_configs)
     obs.emit_memory_gauges()
+    # Quarantine accounting AFTER every artifact is on disk: the sidecar
+    # records this run's quarantined configs (fault class + attempt
+    # history) and clears entries for configs that completed this time.
+    # Quarantined configs are ABSENT from the pickle (strict 4-element
+    # value schema — see _write_timing_meta), so the per-config resume
+    # above naturally re-attempts exactly them on the next run.
+    rquarantine.update_sidecar(
+        rquarantine.sidecar_path(out_file), engine.quarantined,
+        completed=scores_all.keys(),
+    )
+    if engine.quarantined:
+        for keys, rec in sorted(engine.quarantined.items()):
+            progress_out.write(
+                f"QUARANTINED {'/'.join(keys)} "
+                f"[{rec['fault_class']}] after "
+                f"{len(rec['attempts'])} attempt(s)\n")
+        raise rquarantine.QuarantinedConfigs(engine.quarantined,
+                                             scores=scores_all)
     return scores_all
 
 

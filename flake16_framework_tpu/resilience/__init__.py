@@ -1,0 +1,37 @@
+"""resilience — the unified fault-tolerance layer (ISSUE 3).
+
+One taxonomy, one guard, one ladder, one quarantine record for the
+failure modes that previously aborted whole sweeps (PROFILE.md
+"Device-fault envelope"; round-1/2 post-mortems):
+
+- faults.py      — the fault classifier ({transient-device, oom,
+                   deterministic, envelope-overrun, relay-down})
+- guard.py       — the dispatch guard: watchdog deadline, retries with
+                   exponential backoff + jitter, relay gate
+- ladder.py      — the degradation ladder: pallas->xla, halve chunk
+                   bounds on oom, CPU fallback on relay-down
+- inject.py      — F16_FAULT_INJECT: deterministic fault injection so
+                   tier-1 exercises every path on CPU
+- quarantine.py  — the per-config quarantine sidecar + nonzero exit
+
+No module here imports jax at import time: the relay-down diagnosis must
+run while any jax import would hang at backend init (utils/relay.py).
+"""
+
+from flake16_framework_tpu.resilience import (  # noqa: F401
+    faults, inject, ladder, quarantine,
+)
+from flake16_framework_tpu.resilience.faults import (  # noqa: F401
+    DETERMINISTIC, ENVELOPE_OVERRUN, FAULT_CLASSES, OOM, RELAY_DOWN,
+    RETRYABLE, TRANSIENT_DEVICE, classify, classify_message,
+)
+from flake16_framework_tpu.resilience.guard import (  # noqa: F401
+    BackoffPolicy, DispatchAbandoned, DispatchGuard, default_guard,
+    policy_from_env, relay_is_device_path,
+)
+from flake16_framework_tpu.resilience.inject import (  # noqa: F401
+    InjectedFault, parse_plan, plan_from_env,
+)
+from flake16_framework_tpu.resilience.quarantine import (  # noqa: F401
+    QUARANTINE_EXIT_CODE, QuarantinedConfigs,
+)

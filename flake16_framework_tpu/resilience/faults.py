@@ -1,0 +1,103 @@
+"""The fault taxonomy and classifier — one vocabulary for every failure
+the tunneled-TPU sweep can see (ISSUE 3; PROFILE.md "Device-fault
+envelope" and the round-1/2 post-mortems).
+
+jaxlib runtime errors share no usable base class across versions, and the
+gRPC status of a device fault arrives only as a MESSAGE PREFIX
+("UNAVAILABLE: TPU device error"), so classification is textual by
+necessity. The contract callers rely on:
+
+- ``transient-device`` — the tunnel's fault signature (gRPC UNAVAILABLE /
+  DEADLINE_EXCEEDED / ABORTED prefixes). Deterministic dispatches, so a
+  retry is bit-identical; the dispatch guard retries with backoff.
+- ``oom`` — RESOURCE_EXHAUSTED / allocator failures. Retried after the
+  degradation ladder halves the chunk bounds (ops are chunk-invariant by
+  design, so results are unchanged at a smaller chunk).
+- ``envelope-overrun`` — a dispatch outran the device-fault envelope
+  watchdog (single dispatches past ~170 s fault the tunnel; the guard
+  gives up on the dispatch BEFORE it wedges the relay). Retried at
+  halved dispatch bounds.
+- ``relay-down`` — the relay listener is gone while it is the device
+  path. Retried after the relay gate (and, if it stays down, the
+  CPU-backend rung of the ladder).
+- ``deterministic`` — everything else: Mosaic lowering errors, shape
+  errors, programming bugs. NEVER retried (a bit-identical replay would
+  fail identically); the sweep quarantines the config instead.
+
+Prefix matching is deliberate: an incidental "UNAVAILABLE" later in an
+unrelated message (e.g. "INTERNAL: upstream said UNAVAILABLE") is NOT a
+device fault and must classify deterministic — tests/test_sweep.py pins
+this exact case.
+
+No jax import at module level: tools/recovery_watch.py classifies stage
+stderr while the relay may be down, and any jax import would hang at
+backend init (utils/relay.py docstring).
+"""
+
+TRANSIENT_DEVICE = "transient-device"
+OOM = "oom"
+DETERMINISTIC = "deterministic"
+ENVELOPE_OVERRUN = "envelope-overrun"
+RELAY_DOWN = "relay-down"
+
+FAULT_CLASSES = (TRANSIENT_DEVICE, OOM, DETERMINISTIC, ENVELOPE_OVERRUN,
+                 RELAY_DOWN)
+
+# Classes the dispatch guard may re-attempt (deterministic faults would
+# replay bit-identically into the same failure).
+RETRYABLE = frozenset((TRANSIENT_DEVICE, OOM, ENVELOPE_OVERRUN, RELAY_DOWN))
+
+# gRPC status prefixes of the tunnel's transient fault signatures
+# (XlaRuntimeError stringifies as "<STATUS>: <detail>").
+_TRANSIENT_PREFIXES = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED")
+_OOM_PREFIXES = ("RESOURCE_EXHAUSTED",)
+# Substring markers for allocator failures whose status prefix is absent
+# (e.g. a bare "Out of memory while trying to allocate ..." from TFRT).
+_OOM_MARKERS = ("out of memory", "resource exhausted", "resource_exhausted",
+                "failed to allocate")
+_RELAY_MARKERS = ("relay listener", "tunnel down")
+
+
+class EnvelopeOverrun(RuntimeError):
+    """A guarded dispatch outran the device-fault envelope watchdog."""
+
+    fault_class = ENVELOPE_OVERRUN
+
+
+class RelayDown(RuntimeError):
+    """The relay listener is down while it is the device path."""
+
+    fault_class = RELAY_DOWN
+
+
+def classify(exc):
+    """Fault class for an exception (one of FAULT_CLASSES).
+
+    An explicit ``fault_class`` attribute wins (our own exceptions and
+    injected faults carry one); MemoryError is host OOM; everything else
+    classifies by message via ``classify_message``."""
+    fc = getattr(exc, "fault_class", None)
+    if fc in FAULT_CLASSES:
+        return fc
+    if isinstance(exc, MemoryError):
+        return OOM
+    return classify_message(str(exc))
+
+
+def classify_message(message):
+    """Fault class for an error message (also: a stage's stderr tail —
+    tools/recovery_watch.py feeds multi-line text, so prefixes are
+    checked per line)."""
+    lines = (message or "").splitlines() or [""]
+    for line in lines:
+        head = line.strip()
+        if head.startswith(_TRANSIENT_PREFIXES):
+            return TRANSIENT_DEVICE
+        if head.startswith(_OOM_PREFIXES):
+            return OOM
+    low = (message or "").lower()
+    if any(m in low for m in _OOM_MARKERS):
+        return OOM
+    if any(m in low for m in _RELAY_MARKERS):
+        return RELAY_DOWN
+    return DETERMINISTIC

@@ -1,0 +1,221 @@
+"""The dispatch guard — THE wrapper for jitted device dispatches.
+
+One place owns what used to be scattered ad-hoc (ISSUE 3): the
+single-retry in parallel/sweep.py's old ``run_bounded``, the 5 s sleep,
+and the "is the tunnel even up" question. A guarded dispatch:
+
+1. consults the injection plan (``F16_FAULT_INJECT``, inject.py) so every
+   path below is deterministically exercisable on CPU;
+2. runs the thunk under an optional watchdog deadline enforcing the
+   device-fault envelope (``F16_FAULT_ENVELOPE_S``; PROFILE.md: single
+   dispatches past ~170 s fault the tunnel — better to give up on the
+   dispatch than to wedge the relay). Default 0 = off, so CPU tier-1
+   stays thread-free;
+3. classifies any failure (faults.py) and either
+   - retries with exponential backoff + jitter (bounded attempts) after
+     stepping the degradation ladder (ladder.py) for classes with a
+     rung, consulting ``relay_listener_up()`` before re-dispatching when
+     the relay is the device path, or
+   - raises ``DispatchAbandoned`` (deterministic class, or retries
+     exhausted) carrying the fault class + full attempt history — the
+     record the sweep's quarantine ledger persists.
+
+Guarded thunks must be deterministic (the sweep's dispatches are: chunk
+slices of explicit key tables), so a retry is bit-identical.
+
+Every transition emits a ``fault`` obs event (schema.EVENT_FIELDS), so
+``report`` can render the run's fault summary.
+
+Backoff sleeps go through ``time.sleep`` looked up AT CALL TIME (tests
+monkeypatch the module attribute), or an injected ``sleep`` callable.
+No jax import at module level — tools/recovery_watch.py needs the relay
+gate while jax would hang at backend init.
+"""
+
+import os
+import random
+import sys
+import threading
+import time
+
+from flake16_framework_tpu import obs
+from flake16_framework_tpu.resilience import faults, inject, ladder
+from flake16_framework_tpu.utils import relay as relay_mod
+
+
+class DispatchAbandoned(RuntimeError):
+    """A guarded dispatch gave up: non-retryable class, or retries
+    exhausted. ``fault_class``/``attempts``/``original`` carry the
+    quarantine record; the attribute also makes an OUTER guard classify
+    this exception as the inner fault class (nested guards: the chunk
+    guard inside _chunked_fit under the per-config guard)."""
+
+    def __init__(self, label, fault_class, attempts, original):
+        super().__init__(
+            f"dispatch {label or '?'} abandoned after {len(attempts)} "
+            f"attempt(s) [{fault_class}]: {original}")
+        self.label = label
+        self.fault_class = fault_class
+        self.attempts = list(attempts)
+        self.original = original
+
+
+class BackoffPolicy:
+    """Exponential backoff with multiplicative jitter; ``max_attempts``
+    bounds total tries (1 = no retry)."""
+
+    def __init__(self, max_attempts=3, base_s=5.0, factor=2.0, max_s=60.0,
+                 jitter=0.5):
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_s = float(base_s)
+        self.factor = float(factor)
+        self.max_s = float(max_s)
+        self.jitter = float(jitter)
+
+    def delay_s(self, failed_attempt, rng):
+        """Backoff after the ``failed_attempt``-th (1-based) failure."""
+        d = min(self.max_s, self.base_s * self.factor ** (failed_attempt - 1))
+        if self.jitter and d > 0:
+            d *= 1.0 + self.jitter * rng.random()
+        return d
+
+
+def policy_from_env(environ=None):
+    env = environ if environ is not None else os.environ
+    return BackoffPolicy(
+        max_attempts=int(env.get("F16_FAULT_MAX_ATTEMPTS", "3") or 3),
+        base_s=float(env.get("F16_FAULT_BACKOFF_S", "5") or 0.0),
+        max_s=float(env.get("F16_FAULT_BACKOFF_MAX_S", "60") or 60.0),
+    )
+
+
+def relay_is_device_path(environ=None):
+    """The relay gate applies only where the relay IS the device path —
+    same predicate bench.py's probe uses (the axon hook env)."""
+    env = environ if environ is not None else os.environ
+    return bool(env.get("PALLAS_AXON_POOL_IPS"))
+
+
+class DispatchGuard:
+    """See module docstring. ``sleep``/``rng`` are injectable so tests
+    exercise the backoff schedule without real sleeps; ``block=True``
+    blocks on the thunk's result inside the guard (device faults of an
+    async dispatch must surface HERE, not at the caller's later sync)."""
+
+    def __init__(self, policy=None, plan=None, *, sleep=None, rng=None,
+                 envelope_s=None, relay_wait_s=60.0, relay_poll_s=5.0,
+                 block=True):
+        self.policy = policy or BackoffPolicy()
+        self.plan = plan
+        # Default sleeper resolves time.sleep per call (monkeypatchable).
+        self._sleep = sleep if sleep is not None else (
+            lambda s: time.sleep(s))
+        self._rng = rng if rng is not None else random.Random(0xF16)
+        if envelope_s is None:
+            envelope_s = float(os.environ.get("F16_FAULT_ENVELOPE_S", "0")
+                               or 0.0)
+        self.envelope_s = envelope_s
+        self.relay_wait_s = relay_wait_s
+        self.relay_poll_s = relay_poll_s
+        self.block = block
+
+    def call(self, thunk, *, config_index=None, label=None):
+        """Run ``thunk`` under the guard; returns its result or raises
+        DispatchAbandoned with the attempt history."""
+        attempts = []
+        lbl = {"config": label} if label else {}
+        n = self.policy.max_attempts
+        for attempt in range(1, n + 1):
+            try:
+                if self.plan is not None:
+                    self.plan.check(config_index, attempt)
+                out = self._dispatch(thunk)
+                if attempts:
+                    obs.event("fault",
+                              fault_class=attempts[-1]["fault_class"],
+                              action="recovered", attempt=attempt, **lbl)
+                return out
+            except Exception as e:
+                fc = faults.classify(e)
+                rec = {"attempt": attempt, "fault_class": fc,
+                       "error": str(e)[:200]}
+                attempts.append(rec)
+                if fc not in faults.RETRYABLE or attempt >= n:
+                    obs.event("fault", fault_class=fc, action="abandon",
+                              attempt=attempt, error=rec["error"], **lbl)
+                    raise DispatchAbandoned(label, fc, attempts, e) from e
+                ladder.step(fc, attempt=attempt, context=label)
+                if fc in (faults.TRANSIENT_DEVICE, faults.RELAY_DOWN) \
+                        and relay_is_device_path():
+                    if not self._await_relay():
+                        # The relay stayed down past the wait budget:
+                        # step to the CPU rung before the retry rather
+                        # than re-dispatching into a dead tunnel.
+                        ladder.step(faults.RELAY_DOWN, attempt=attempt,
+                                    context=label)
+                delay = self.policy.delay_s(attempt, self._rng)
+                rec["backoff_s"] = round(delay, 3)
+                obs.event("fault", fault_class=fc, action="retry",
+                          attempt=attempt, backoff_s=rec["backoff_s"],
+                          error=rec["error"], **lbl)
+                if delay > 0:
+                    self._sleep(delay)
+
+    # -- internals ------------------------------------------------------
+
+    def _finish(self, out):
+        if self.block:
+            jaxmod = sys.modules.get("jax")
+            if jaxmod is not None:
+                jaxmod.block_until_ready(out)
+        return out
+
+    def _dispatch(self, thunk):
+        if not self.envelope_s or self.envelope_s <= 0:
+            return self._finish(thunk())
+        # Watchdog: dispatch+block in a daemon worker so the deadline can
+        # fire even while jax blocks. An overrun orphans the worker (jax
+        # gives no way to cancel an in-flight dispatch) — acceptable: the
+        # alternative is wedging the whole process against the tunnel.
+        box = {}
+
+        def work():
+            try:
+                box["out"] = self._finish(thunk())
+            except BaseException as e:  # must cross the thread boundary
+                box["exc"] = e
+
+        t = threading.Thread(target=work, daemon=True,
+                             name="f16-dispatch-guard")
+        t.start()
+        t.join(self.envelope_s)
+        if t.is_alive():
+            raise faults.EnvelopeOverrun(
+                f"dispatch exceeded the {self.envelope_s:g}s device-fault "
+                f"envelope (PROFILE.md: long dispatches fault the tunnel)")
+        if "exc" in box:
+            raise box["exc"]
+        return box["out"]
+
+    def _await_relay(self):
+        """Poll the relay listener up to ``relay_wait_s``; True when it is
+        up (or unknown — a probe-less host must not block the retry),
+        False when it stayed decisively down."""
+        waited = 0.0
+        while True:
+            up = relay_mod.relay_listener_up()
+            if up is not False:
+                return True
+            if waited >= self.relay_wait_s:
+                return False
+            step_s = min(self.relay_poll_s, self.relay_wait_s - waited)
+            self._sleep(step_s)
+            waited += step_s
+
+
+def default_guard(plan=None, **kw):
+    """The env-configured guard (F16_FAULT_MAX_ATTEMPTS /
+    F16_FAULT_BACKOFF_S / F16_FAULT_ENVELOPE_S / F16_FAULT_INJECT)."""
+    if plan is None:
+        plan = inject.plan_from_env()
+    return DispatchGuard(policy=policy_from_env(), plan=plan, **kw)

@@ -1,0 +1,111 @@
+"""The fault-injection harness: deterministic faults on CPU for tier-1.
+
+Every resilience path (classify -> retry -> degrade -> quarantine) must
+be exercisable without a real faulting device. ``F16_FAULT_INJECT`` holds
+a plan of ``;``-separated entries:
+
+    <config>:<attempt>:<class>
+
+- ``config`` — the config's index in the canonical 216-config order
+  (``config.iter_config_keys()``; the same index the sweep already uses
+  for its per-config RNG fold_in), or ``*`` for every config.
+- ``attempt`` — the 1-based dispatch attempt to fail, or ``*`` to fail
+  every attempt (exhausts retries -> quarantine).
+- ``class`` — a fault class from faults.FAULT_CLASSES, or a short alias:
+  transient, oom, deterministic, envelope, relay.
+
+Examples (see PROFILE.md "Fault tolerance"):
+
+    F16_FAULT_INJECT="3:1:transient"        # config 3 faults once, retries
+    F16_FAULT_INJECT="5:1:oom;7:*:transient"  # 5 degrades, 7 quarantines
+
+The guard consults the plan BEFORE each dispatch attempt, so an injected
+fault takes the exact classify/retry path a real device fault would.
+With a plan active the sweep runs the per-config path (no mesh batching)
+so config indices address dispatches deterministically.
+"""
+
+import os
+
+from flake16_framework_tpu.resilience import faults
+
+ENV_VAR = "F16_FAULT_INJECT"
+
+_CLASS_ALIASES = {
+    "transient": faults.TRANSIENT_DEVICE,
+    "oom": faults.OOM,
+    "deterministic": faults.DETERMINISTIC,
+    "envelope": faults.ENVELOPE_OVERRUN,
+    "relay": faults.RELAY_DOWN,
+}
+_CLASS_ALIASES.update({c: c for c in faults.FAULT_CLASSES})
+
+
+class InjectedFault(RuntimeError):
+    """A plan-scheduled fault. Carries ``fault_class`` so faults.classify
+    routes it exactly like the real thing."""
+
+    def __init__(self, message, fault_class):
+        super().__init__(message)
+        self.fault_class = fault_class
+
+
+class FaultPlan:
+    """A parsed injection plan: entries of (config_index, attempt, class),
+    None meaning wildcard for the first two."""
+
+    def __init__(self, entries):
+        self.entries = tuple(entries)
+
+    def __bool__(self):
+        return bool(self.entries)
+
+    def check(self, config_index, attempt):
+        """Raise InjectedFault when the plan schedules a fault for this
+        (config, attempt) dispatch; no-op otherwise."""
+        for k, j, fc in self.entries:
+            if (k is None or k == config_index) and \
+                    (j is None or j == attempt):
+                raise InjectedFault(
+                    f"injected {fc} fault "
+                    f"(config {config_index}, attempt {attempt})", fc)
+
+
+def parse_plan(spec):
+    """Parse an F16_FAULT_INJECT value; raises ValueError on bad grammar
+    (a typo'd plan silently injecting nothing would defeat the harness)."""
+    entries = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        if len(parts) != 3:
+            raise ValueError(
+                f"{ENV_VAR} entry {raw!r}: want <config>:<attempt>:<class>")
+        k_s, j_s, fc_s = (p.strip() for p in parts)
+        try:
+            k = None if k_s == "*" else int(k_s)
+            j = None if j_s == "*" else int(j_s)
+        except ValueError:
+            raise ValueError(
+                f"{ENV_VAR} entry {raw!r}: config/attempt must be an "
+                f"integer or '*'") from None
+        if j is not None and j < 1:
+            raise ValueError(
+                f"{ENV_VAR} entry {raw!r}: attempts are 1-based")
+        fc = _CLASS_ALIASES.get(fc_s)
+        if fc is None:
+            raise ValueError(
+                f"{ENV_VAR} entry {raw!r}: unknown fault class {fc_s!r} "
+                f"(want one of {sorted(set(_CLASS_ALIASES))})")
+        entries.append((k, j, fc))
+    return FaultPlan(entries)
+
+
+def plan_from_env(environ=None):
+    """The active plan from F16_FAULT_INJECT, or None when unset/empty."""
+    spec = (environ if environ is not None else os.environ).get(ENV_VAR, "")
+    if not spec.strip():
+        return None
+    return parse_plan(spec)

@@ -1,0 +1,124 @@
+"""The degradation ladder: per-process state that steps DOWN on a fault
+class instead of dying (ISSUE 3 tentpole).
+
+Rungs, per fault class:
+
+- pallas -> xla (``pallas_broken``): generalizes ops/treeshap.py's old
+  sticky ``_PALLAS_AUTO_BROKEN`` flag — after an auto-mode kernel
+  failure every later auto call takes the XLA formulation (same values;
+  interpret-mode equality is test-pinned) instead of re-running the
+  broken Mosaic compile per chunk.
+- halve the chunk bounds (``halvings``): on oom / envelope-overrun the
+  guard steps here before retrying. ``halved()`` is consulted by the
+  sweep's dispatch bounds (parallel/sweep.py _dispatch_bounds,
+  _auto_tree_chunk), the tree-growth chunking (ops/trees.py _map_trees)
+  and the SHAP chunk bounds (ops/treeshap.py) — all chunk-invariant by
+  design, so a degraded retry produces bit-identical results in a
+  smaller workspace / shorter dispatch.
+- CPU backend fallback (``cpu_fallback``): when the relay stays down,
+  ``device_context()`` pins subsequent guarded dispatches to the host
+  CPU device so the sweep finishes degraded rather than wedging against
+  a dead tunnel.
+
+State is process-global on purpose (like the flag it absorbs): a broken
+kernel or an undersized device stays broken for the process, and every
+later dispatch should inherit the step-down. ``reset()`` restores the
+top rung (tests; a fresh process starts there anyway).
+"""
+
+import contextlib
+import sys
+
+from flake16_framework_tpu import obs
+from flake16_framework_tpu.resilience import faults
+
+# Floor for halvings: 6 halvings divide any practical chunk to 1 anyway,
+# and an unbounded counter would let a pathological OOM loop shift
+# forever for nothing.
+MAX_HALVINGS = 6
+
+
+class DegradationState:
+    __slots__ = ("pallas_broken", "halvings", "cpu_fallback")
+
+    def __init__(self):
+        self.pallas_broken = False
+        self.halvings = 0
+        self.cpu_fallback = False
+
+
+_STATE = DegradationState()
+
+
+def state():
+    return _STATE
+
+
+def reset():
+    """Back to the top rung (per-process; mainly for tests)."""
+    _STATE.pallas_broken = False
+    _STATE.halvings = 0
+    _STATE.cpu_fallback = False
+
+
+def halved(chunk):
+    """Apply the ladder's halvings to a chunk/dispatch bound; None (no
+    bound) passes through, and the result never drops below 1."""
+    if chunk is None or not _STATE.halvings:
+        return chunk
+    return max(1, int(chunk) >> min(_STATE.halvings, MAX_HALVINGS))
+
+
+def step(fault_class, *, attempt=0, context=None):
+    """Take one ladder step for a fault class; returns the step name, or
+    None when the class has no rung (transient faults just retry) or the
+    ladder is already at its floor. Emits the ``fault``/degrade event."""
+    if fault_class in (faults.OOM, faults.ENVELOPE_OVERRUN):
+        if _STATE.halvings >= MAX_HALVINGS:
+            return None
+        _STATE.halvings += 1
+        action = "halve-chunk"
+    elif fault_class == faults.RELAY_DOWN:
+        if _STATE.cpu_fallback:
+            return None
+        _STATE.cpu_fallback = True
+        action = "cpu-fallback"
+    else:
+        return None
+    fields = {"step": action, "halvings": _STATE.halvings}
+    if context:
+        fields["config"] = context
+    obs.event("fault", fault_class=fault_class, action="degrade",
+              attempt=int(attempt), **fields)
+    return action
+
+
+def mark_pallas_broken(exc=None):
+    """The pallas->xla rung (called from ops/treeshap.py's auto fallback).
+    Returns True on the FIRST marking — callers use that to warn once."""
+    if _STATE.pallas_broken:
+        return False
+    _STATE.pallas_broken = True
+    obs.event("fault",
+              fault_class=(faults.classify(exc) if exc is not None
+                           else faults.DETERMINISTIC),
+              action="degrade", attempt=0, step="pallas-to-xla",
+              error=str(exc)[:200] if exc is not None else "")
+    return True
+
+
+def device_context():
+    """Context manager pinning dispatches to the host CPU device while the
+    ladder is on the cpu-fallback rung; a no-op otherwise (and whenever
+    jax is not already up — this module must never initialize a backend,
+    see utils/relay.py on relay-down hangs)."""
+    if not _STATE.cpu_fallback:
+        return contextlib.nullcontext()
+    jaxmod = sys.modules.get("jax")
+    if jaxmod is None:
+        return contextlib.nullcontext()
+    try:
+        cpu = jaxmod.devices("cpu")[0]
+    except Exception:
+        return contextlib.nullcontext()
+    return jaxmod.default_device(cpu)

@@ -1,0 +1,100 @@
+"""Per-config quarantine: the ledger sidecar and the nonzero exit.
+
+A config that exhausts the dispatch guard's retries must not abort the
+other 215 (the pre-ISSUE-3 behavior): the sweep records it — fault class
+plus full attempt history — in ``<scores.pkl>.quarantine.json`` beside
+the checkpoint ledger and keeps going. The scores pickle itself NEVER
+holds quarantine markers: its values keep the exact 4-element reference
+schema (the reference's readers unpack strictly — see
+pipeline._write_timing_meta on the same constraint), so a quarantined
+config is simply ABSENT, and the existing per-config resume re-attempts
+exactly the quarantined configs on the next run. A re-attempt that
+completes clears the sidecar entry.
+
+``write_scores`` finishes the sweep, persists everything, then raises
+``QuarantinedConfigs`` (a SystemExit with code QUARANTINE_EXIT_CODE) so
+``python -m flake16_framework_tpu scores`` exits nonzero listing only
+the quarantined configs — partial success is visible to CI without
+being mistaken for a clean run.
+"""
+
+import json
+import os
+
+SIDECAR_SCHEMA = "flake16-quarantine-v1"
+# Distinct from lint's 1/2 and generic failures: "the sweep finished but
+# quarantined configs remain" is its own, scriptable condition.
+QUARANTINE_EXIT_CODE = 23
+
+
+def sidecar_path(out_file):
+    return str(out_file) + ".quarantine.json"
+
+
+def load_sidecar(path):
+    """{config_keys_tuple: {"fault_class": ..., "attempts": [...]}} from a
+    sidecar; {} when absent or unreadable (the sidecar is a record, not a
+    gate — a torn write must not block a resume)."""
+    try:
+        with open(path) as fd:
+            doc = json.load(fd)
+    except (OSError, ValueError):
+        return {}
+    entries = {}
+    for rec in doc.get("configs", ()):
+        try:
+            keys = tuple(rec["config"])
+        except (TypeError, KeyError):
+            continue
+        entries[keys] = {"fault_class": rec.get("fault_class", "?"),
+                         "attempts": list(rec.get("attempts", ()))}
+    return entries
+
+
+def save_sidecar(path, entries):
+    """Atomic write (tmp + os.replace, like the pickle it sits beside)."""
+    doc = {
+        "schema": SIDECAR_SCHEMA,
+        "note": ("configs quarantined by the resilience layer: each "
+                 "exhausted the dispatch guard's retries (attempt history "
+                 "below) and is ABSENT from the scores pickle, so a "
+                 "resumed run re-attempts exactly these"),
+        "configs": [
+            {"config": list(keys), "fault_class": e.get("fault_class", "?"),
+             "attempts": list(e.get("attempts", ()))}
+            for keys, e in sorted(entries.items())
+        ],
+    }
+    with open(path + ".tmp", "w") as fd:
+        json.dump(doc, fd, indent=1)
+    os.replace(path + ".tmp", path)
+
+
+def update_sidecar(path, quarantined, completed=()):
+    """Merge this run's quarantine set into the sidecar: entries for
+    configs now completed are cleared, fresh entries win over stale ones.
+    Returns the merged dict. The file is (re)written whenever there is
+    anything to record or clear."""
+    prev = load_sidecar(path)
+    done = {tuple(k) for k in completed}
+    merged = {k: v for k, v in prev.items() if k not in done}
+    merged.update({tuple(k): v for k, v in quarantined.items()})
+    if merged or prev or os.path.exists(path):
+        save_sidecar(path, merged)
+    return merged
+
+
+class QuarantinedConfigs(SystemExit):
+    """Raised by write_scores AFTER the sweep completed and every artifact
+    is on disk: carries the quarantine dict (and the scores produced) and
+    exits with QUARANTINE_EXIT_CODE under the CLI."""
+
+    def __init__(self, quarantined, scores=None):
+        super().__init__(QUARANTINE_EXIT_CODE)
+        self.quarantined = dict(quarantined)
+        self.scores = scores
+
+    def __str__(self):
+        names = ", ".join("/".join(k) for k in sorted(self.quarantined))
+        return (f"{len(self.quarantined)} config(s) quarantined "
+                f"(exit {QUARANTINE_EXIT_CODE}): {names}")

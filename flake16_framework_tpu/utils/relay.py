@@ -4,20 +4,38 @@ cheap check, shared by bench.py and tools/recovery_watch.py.
 The TPU in this environment is reached through a local relay; when its
 host side dies, every jax process hangs forever at backend init, so
 liveness must be established WITHOUT jax — a TCP listener probe via
-``ss -tln``. Decisive only where the relay is actually the device path
-(callers gate on the axon hook env)."""
+``ss -tln``, falling back to a direct loopback connect when ``ss`` is
+unavailable (minimal containers). Decisive only where the relay is
+actually the device path (callers gate on the axon hook env)."""
 
+import socket
 import subprocess
 
 RELAY_PORT = "8082"
 
 
 def relay_listener_up(timeout=10):
-    """True/False for a listener on the relay port; None when ``ss`` itself
-    is unavailable (callers must treat None as unknown, not down)."""
+    """True/False for a listener on the relay port; None only when NEITHER
+    probe can decide (callers must treat None as unknown, not down).
+
+    Probe order: ``ss -tln`` (no connection made — a listener under
+    connect backpressure still reads as up); when ``ss`` is missing or
+    fails, a direct ``socket.create_connection`` to the loopback port —
+    connect succeeds => up, connection refused => decisively down, any
+    other socket error (timeout, no route) => unknown."""
     try:
         r = subprocess.run(["ss", "-tln"], capture_output=True, text=True,
                            timeout=timeout)
-        return (":" + RELAY_PORT) in r.stdout
+        if r.returncode == 0:
+            return (":" + RELAY_PORT) in r.stdout
     except Exception:
+        pass
+    try:
+        conn = socket.create_connection(("127.0.0.1", int(RELAY_PORT)),
+                                        timeout=min(timeout, 3))
+    except ConnectionRefusedError:
+        return False
+    except OSError:
         return None
+    conn.close()
+    return True

@@ -8,12 +8,23 @@ shapes. Env vars must be set before jax initializes, hence at conftest import.
 
 import os
 import sys
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     + " --xla_force_host_platform_device_count=8"
 )
+# Persistent XLA compilation cache, shared by every test in the run AND by
+# the subprocesses tests spawn (bench.py, __graft_entry__ children — env
+# vars propagate where jax.config would not). The suite's wall is compile-
+# dominated and many tests lower the same HLO from fresh jit closures;
+# cache keys are HLO fingerprints, so code changes can never serve stale
+# executables. Tier-1 fits its 870s budget because of this — keep it.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "f16-jax-compile-cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # This jaxlib build ignores the JAX_ENABLE_X64 env var; set it via config so
@@ -27,3 +38,24 @@ jax.config.update("jax_enable_x64", True)
 # forever on the single-claim tunnel. Force the platform list back to cpu so
 # the axon backend is never initialized in tests.
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+_EXIT_STATUS = [0]
+
+
+def pytest_sessionfinish(session, exitstatus):
+    _EXIT_STATUS[0] = int(exitstatus)
+
+
+def pytest_unconfigure(config):
+    # Interpreter teardown of a full run — gc over hundreds of loaded XLA
+    # executables plus the 8-device client — costs 15s+ of the tier-1 870s
+    # budget while producing nothing: every artifact (cache entries, test
+    # tmpdirs, report) is already flushed by now, and unconfigure runs
+    # after the terminal reporter's summary. Exit immediately, preserving
+    # pytest's exit status.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(_EXIT_STATUS[0])

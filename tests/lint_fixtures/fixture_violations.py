@@ -53,6 +53,13 @@ def telemetry_drift():
         obs.event("made_up_kind", x=1)             # expect O102
 
 
+def unguarded_dispatch(x):
+    try:
+        return jax.block_until_ready(jnp.sum(x))
+    except Exception:                              # expect J501
+        return None
+
+
 def suppressed_examples(xs):
     """Inline suppressions — test_lint.py asserts these do NOT surface."""
     jax.debug.print("kept = {}", xs)  # f16lint: disable=J401

@@ -1,0 +1,536 @@
+"""Fault-tolerance layer tests (resilience/): classifier table, backoff
+schedule (injected clock — no real sleeps), ladder transitions, quarantine
+ledger round-trip, and the two injection e2e drills from ISSUE 3's
+acceptance criteria — all CPU-only.
+"""
+
+import json
+import os
+import pickle
+
+import pytest
+
+from flake16_framework_tpu import config as cfg, obs
+from flake16_framework_tpu.obs import report as obs_report
+from flake16_framework_tpu.parallel.sweep import SweepEngine
+from flake16_framework_tpu.pipeline import write_scores
+from flake16_framework_tpu.resilience import (
+    faults, guard, inject, ladder, quarantine,
+)
+from flake16_framework_tpu.utils import relay as relay_mod
+from flake16_framework_tpu.utils.synth import make_tests_json
+
+
+@pytest.fixture(autouse=True)
+def _ladder_reset():
+    """The ladder is process-global on purpose; tests must not leak
+    halvings/fallback rungs into each other (or into other test files)."""
+    ladder.reset()
+    yield
+    ladder.reset()
+
+
+# -- classifier ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("message,expected", [
+    ("UNAVAILABLE: TPU device error", faults.TRANSIENT_DEVICE),
+    ("DEADLINE_EXCEEDED: stage bench timeout", faults.TRANSIENT_DEVICE),
+    ("ABORTED: claim lost", faults.TRANSIENT_DEVICE),
+    ("RESOURCE_EXHAUSTED: hbm oom", faults.OOM),
+    ("Out of memory while trying to allocate 4096 bytes", faults.OOM),
+    ("failed to allocate request for 2.0GiB", faults.OOM),
+    ("no relay listener on :8082 (tunnel down; ss -tln)", faults.RELAY_DOWN),
+    ("ValueError: shapes (3,) and (4,) not aligned", faults.DETERMINISTIC),
+    # prefix-only matching: an incidental UNAVAILABLE mid-message is NOT a
+    # device fault (tests/test_sweep.py pins the same case end-to-end)
+    ("INTERNAL: upstream said UNAVAILABLE in passing", faults.DETERMINISTIC),
+    ("", faults.DETERMINISTIC),
+    # stderr tails are multi-line; the status prefix may open any line
+    ("traceback...\nUNAVAILABLE: socket closed", faults.TRANSIENT_DEVICE),
+])
+def test_classify_message_table(message, expected):
+    assert faults.classify_message(message) == expected
+
+
+def test_classify_exception_attribute_and_memoryerror():
+    assert faults.classify(faults.EnvelopeOverrun("x")) == \
+        faults.ENVELOPE_OVERRUN
+    assert faults.classify(faults.RelayDown("x")) == faults.RELAY_DOWN
+    assert faults.classify(MemoryError()) == faults.OOM
+    assert faults.classify(RuntimeError("UNAVAILABLE: dead")) == \
+        faults.TRANSIENT_DEVICE
+    inj = inject.InjectedFault("boom", faults.OOM)
+    assert faults.classify(inj) == faults.OOM
+    # DispatchAbandoned carries the INNER class so nested guards agree
+    e = guard.DispatchAbandoned("lbl", faults.OOM, [{"attempt": 1}],
+                                RuntimeError("x"))
+    assert faults.classify(e) == faults.OOM
+
+
+# -- injection plan grammar ---------------------------------------------
+
+
+def test_parse_plan_grammar():
+    p = inject.parse_plan("3:1:transient; 5:*:oom ;*:2:relay")
+    assert p.entries == (
+        (3, 1, faults.TRANSIENT_DEVICE),
+        (5, None, faults.OOM),
+        (None, 2, faults.RELAY_DOWN),
+    )
+    with pytest.raises(inject.InjectedFault) as ei:
+        p.check(3, 1)
+    assert ei.value.fault_class == faults.TRANSIENT_DEVICE
+    p.check(3, 3)  # attempt mismatch: no-op
+    p.check(4, 1)  # config mismatch: no-op
+    with pytest.raises(inject.InjectedFault) as ei2:
+        p.check(9, 2)  # wildcard config
+    assert ei2.value.fault_class == faults.RELAY_DOWN
+    with pytest.raises(inject.InjectedFault):
+        p.check(5, 7)  # wildcard attempt
+
+
+@pytest.mark.parametrize("bad", [
+    "3:1", "3:1:transient:extra", "x:1:oom", "3:0:oom", "3:1:nonsense",
+])
+def test_parse_plan_rejects_bad_grammar(bad):
+    with pytest.raises(ValueError):
+        inject.parse_plan(bad)
+
+
+def test_plan_from_env(monkeypatch):
+    monkeypatch.delenv(inject.ENV_VAR, raising=False)
+    assert inject.plan_from_env() is None
+    assert inject.plan_from_env({inject.ENV_VAR: "  "}) is None
+    p = inject.plan_from_env({inject.ENV_VAR: "1:1:oom"})
+    assert p and p.entries == ((1, 1, faults.OOM),)
+
+
+# -- backoff policy ------------------------------------------------------
+
+
+def test_backoff_schedule_no_jitter():
+    import random
+
+    pol = guard.BackoffPolicy(max_attempts=4, base_s=5.0, factor=2.0,
+                              max_s=60.0, jitter=0.0)
+    rng = random.Random(0)
+    assert [pol.delay_s(a, rng) for a in (1, 2, 3, 4, 5)] == \
+        [5.0, 10.0, 20.0, 40.0, 60.0]  # capped at max_s
+
+
+def test_backoff_jitter_bounds():
+    import random
+
+    pol = guard.BackoffPolicy(max_attempts=3, base_s=5.0, factor=2.0,
+                              jitter=0.5)
+    rng = random.Random(0xF16)
+    for a in (1, 2, 3):
+        base = min(60.0, 5.0 * 2.0 ** (a - 1))
+        for _ in range(20):
+            d = pol.delay_s(a, rng)
+            assert base <= d <= 1.5 * base
+
+
+def test_policy_from_env():
+    pol = guard.policy_from_env({
+        "F16_FAULT_MAX_ATTEMPTS": "5", "F16_FAULT_BACKOFF_S": "2",
+        "F16_FAULT_BACKOFF_MAX_S": "17",
+    })
+    assert (pol.max_attempts, pol.base_s, pol.max_s) == (5, 2.0, 17.0)
+    assert guard.policy_from_env({}).max_attempts == 3
+
+
+# -- dispatch guard ------------------------------------------------------
+
+
+def _guard(max_attempts=3, **kw):
+    sleeps = []
+    g = guard.DispatchGuard(
+        policy=guard.BackoffPolicy(max_attempts=max_attempts, base_s=5.0,
+                                   factor=2.0, jitter=0.0),
+        sleep=sleeps.append, block=False, **kw)
+    return g, sleeps
+
+
+def test_guard_retries_transient_then_recovers():
+    g, sleeps = _guard()
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 3:
+            raise RuntimeError("UNAVAILABLE: TPU device error")
+        return "ok"
+
+    assert g.call(flaky, label="t") == "ok"
+    assert calls[0] == 3
+    assert sleeps == [5.0, 10.0]  # the backoff schedule, recorded not slept
+
+
+def test_guard_abandons_deterministic_immediately():
+    g, sleeps = _guard()
+    calls = [0]
+
+    def broken():
+        calls[0] += 1
+        raise ValueError("shape mismatch")
+
+    with pytest.raises(guard.DispatchAbandoned) as ei:
+        g.call(broken, label="cfg/x")
+    assert calls[0] == 1 and sleeps == []
+    e = ei.value
+    assert e.fault_class == faults.DETERMINISTIC
+    assert [a["attempt"] for a in e.attempts] == [1]
+    # the original message rides in str(e): pytest.raises(..., match=...)
+    # on the original error text keeps working through the guard
+    assert "shape mismatch" in str(e)
+
+
+def test_guard_exhausts_retries_then_abandons():
+    g, sleeps = _guard(max_attempts=3)
+
+    def always():
+        raise RuntimeError("UNAVAILABLE: still dead")
+
+    with pytest.raises(guard.DispatchAbandoned) as ei:
+        g.call(always, label="cfg/y")
+    e = ei.value
+    assert e.fault_class == faults.TRANSIENT_DEVICE
+    assert [a["attempt"] for a in e.attempts] == [1, 2, 3]
+    assert len(sleeps) == 2  # no sleep after the final attempt
+
+
+def test_guard_oom_steps_ladder_before_retry():
+    g, _ = _guard()
+    seen = []
+
+    def oomy():
+        seen.append(ladder.state().halvings)
+        if len(seen) < 3:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        return "fits"
+
+    assert g.call(oomy, label="t") == "fits"
+    assert seen == [0, 1, 2]  # one halving per OOM, stepped BEFORE retrying
+
+
+def test_guard_envelope_watchdog():
+    import time as _time
+
+    g = guard.DispatchGuard(
+        policy=guard.BackoffPolicy(max_attempts=1), envelope_s=0.05,
+        sleep=lambda s: None, block=False)
+    with pytest.raises(guard.DispatchAbandoned) as ei:
+        g.call(lambda: _time.sleep(2.0), label="slow")
+    assert ei.value.fault_class == faults.ENVELOPE_OVERRUN
+
+
+def test_guard_relay_gate_steps_cpu_rung(monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setattr(relay_mod, "relay_listener_up", lambda: False)
+    g = guard.DispatchGuard(
+        policy=guard.BackoffPolicy(max_attempts=2, base_s=0.0, jitter=0.0),
+        sleep=lambda s: None, relay_wait_s=0.2, relay_poll_s=0.1,
+        block=False)
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 2:
+            raise RuntimeError("UNAVAILABLE: tunnel fault")
+        return "ok"
+
+    assert g.call(flaky, label="t") == "ok"
+    # the relay stayed decisively down past the wait budget, so the guard
+    # stepped the CPU-fallback rung before re-dispatching
+    assert ladder.state().cpu_fallback is True
+
+
+def test_guard_relay_unknown_does_not_block(monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setattr(relay_mod, "relay_listener_up", lambda: None)
+    g, _ = _guard(max_attempts=2)
+    calls = [0]
+
+    def flaky():
+        calls[0] += 1
+        if calls[0] < 2:
+            raise RuntimeError("UNAVAILABLE: blip")
+        return "ok"
+
+    assert g.call(flaky) == "ok"
+    assert ladder.state().cpu_fallback is False  # unknown != down
+
+
+def test_guard_injected_fault_counts_as_attempt():
+    plan = inject.parse_plan("7:1:transient")
+    g = guard.DispatchGuard(
+        policy=guard.BackoffPolicy(max_attempts=2, base_s=0.0, jitter=0.0),
+        plan=plan, sleep=lambda s: None, block=False)
+    calls = [0]
+    out = g.call(lambda: calls.__setitem__(0, calls[0] + 1) or "ok",
+                 config_index=7, label="drill")
+    assert out == "ok" and calls[0] == 1  # attempt 1 injected, 2 ran
+
+
+# -- degradation ladder --------------------------------------------------
+
+
+def test_halved_math():
+    assert ladder.halved(None) is None
+    assert ladder.halved(64) == 64
+    ladder.step(faults.OOM)
+    assert ladder.halved(64) == 32
+    ladder.step(faults.ENVELOPE_OVERRUN)
+    assert ladder.halved(64) == 16
+    assert ladder.halved(1) == 1  # floor
+    for _ in range(10):
+        ladder.step(faults.OOM)
+    assert ladder.state().halvings <= ladder.MAX_HALVINGS
+    assert ladder.halved(1 << 20) == (1 << 20) >> ladder.MAX_HALVINGS
+
+
+def test_step_names_and_no_rung_classes():
+    assert ladder.step(faults.OOM) == "halve-chunk"
+    assert ladder.step(faults.RELAY_DOWN) == "cpu-fallback"
+    assert ladder.step(faults.RELAY_DOWN) is None  # already on the rung
+    assert ladder.step(faults.TRANSIENT_DEVICE) is None  # no rung: retry
+    assert ladder.step(faults.DETERMINISTIC) is None
+
+
+def test_mark_pallas_broken_once_and_treeshap_proxy():
+    from flake16_framework_tpu.ops import treeshap
+
+    assert treeshap._PALLAS_AUTO_BROKEN[0] is False
+    assert ladder.mark_pallas_broken(RuntimeError("mosaic boom")) is True
+    assert ladder.mark_pallas_broken() is False  # only the FIRST marking
+    # the back-compat proxy reads and steers the ladder state
+    assert treeshap._PALLAS_AUTO_BROKEN[0] is True
+    treeshap._PALLAS_AUTO_BROKEN[0] = False
+    assert ladder.state().pallas_broken is False
+
+
+def test_sweep_dispatch_bounds_follow_halvings():
+    import numpy as np
+
+    eng = SweepEngine(np.zeros((40, 16), np.float32),
+                      np.zeros(40, np.int32), ["p"], ["p"],
+                      np.zeros(40, np.int32), tree_overrides={
+                          "Extra Trees": 8, "Random Forest": 8})
+    assert eng._dispatch_bounds(8) == (None, None)
+    ladder.step(faults.OOM)  # halving 1: a bound appears where none was
+    dc, df = eng._dispatch_bounds(8)
+    assert dc == 4 and df == 5
+    ladder.step(faults.OOM)
+    dc, df = eng._dispatch_bounds(8)
+    assert dc == 2 and df == 2
+
+
+# -- quarantine sidecar --------------------------------------------------
+
+
+def test_sidecar_round_trip_and_merge(tmp_path):
+    path = str(tmp_path / "scores.pkl.quarantine.json")
+    entries = {
+        ("OD", "Flake16", "None", "None", "Extra Trees"):
+            {"fault_class": faults.TRANSIENT_DEVICE,
+             "attempts": [{"attempt": 1, "fault_class": "transient-device",
+                           "error": "x"}]},
+    }
+    quarantine.save_sidecar(path, entries)
+    assert quarantine.load_sidecar(path) == entries
+    doc = json.load(open(path))
+    assert doc["schema"] == quarantine.SIDECAR_SCHEMA
+
+    # merge: a new entry joins, a completed config clears
+    other = ("NOD", "Flake16", "PCA", "SMOTE", "Random Forest")
+    merged = quarantine.update_sidecar(
+        path, {other: {"fault_class": faults.OOM, "attempts": []}})
+    assert set(merged) == set(entries) | {other}
+    merged = quarantine.update_sidecar(path, {},
+                                       completed=list(entries))
+    assert set(merged) == {other}
+    merged = quarantine.update_sidecar(path, {}, completed=[other])
+    assert merged == {} and quarantine.load_sidecar(path) == {}
+
+
+def test_sidecar_unreadable_is_empty(tmp_path):
+    assert quarantine.load_sidecar(str(tmp_path / "nope.json")) == {}
+    bad = tmp_path / "bad.json"
+    bad.write_text("{torn")
+    assert quarantine.load_sidecar(str(bad)) == {}
+
+
+def test_quarantined_configs_exit_code():
+    e = quarantine.QuarantinedConfigs(
+        {("OD", "Flake16", "None", "None", "Extra Trees"):
+         {"fault_class": "oom", "attempts": []}}, scores={"k": 1})
+    assert isinstance(e, SystemExit)
+    assert e.code == quarantine.QUARANTINE_EXIT_CODE == 23
+    assert "OD/Flake16/None/None/Extra Trees" in str(e)
+
+
+# -- ledger resilience ---------------------------------------------------
+
+
+def test_load_ledger_tolerates_corruption(tmp_path):
+    import io
+
+    from flake16_framework_tpu.pipeline import _load_ledger
+
+    out = str(tmp_path / "scores.pkl")
+    assert _load_ledger(out) == {}
+    # truncated pickle: warn + restart all
+    good = {("a",): [1.0, 2.0, {}, {}]}
+    blob = pickle.dumps(good)
+    open(out, "wb").write(blob[:len(blob) // 2])
+    warn = io.StringIO()
+    assert _load_ledger(out, warn_out=warn) == {}
+    assert "unreadable" in warn.getvalue()
+    # wrong top-level type
+    open(out, "wb").write(pickle.dumps([1, 2, 3]))
+    warn = io.StringIO()
+    assert _load_ledger(out, warn_out=warn) == {}
+    assert "not a dict" in warn.getvalue()
+    # malformed entries dropped individually, good ones kept
+    mixed = dict(good)
+    mixed[("bad",)] = [1.0, 2.0]  # not the 4-element schema
+    open(out, "wb").write(pickle.dumps(mixed))
+    warn = io.StringIO()
+    assert _load_ledger(out, warn_out=warn) == good
+    assert "malformed" in warn.getvalue()
+
+
+# -- injection e2e: the acceptance drills -------------------------------
+
+
+@pytest.fixture(scope="module")
+def sweep_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("resilience")
+    make_tests_json(str(d / "tests.json"), n_tests=100, n_projects=3,
+                    seed=11)
+    return d
+
+
+TINY = {"Extra Trees": 4, "Random Forest": 4}
+
+
+def _idx(keys):
+    return list(cfg.iter_config_keys()).index(tuple(keys))
+
+
+def test_injected_transient_and_oom_sweep_completes(sweep_dir, monkeypatch):
+    """Acceptance drill A: a transient fault and an OOM on two distinct
+    configs of a 6-config probe sweep — the transient succeeds on retry,
+    the OOM succeeds at halved chunk bounds, zero configs abort, and the
+    obs report shows the retry/degrade/recovered transitions."""
+    monkeypatch.chdir(sweep_dir)
+    # The OOM-injected config runs LAST: its degraded retry compiles the
+    # halved-bound program variant, and ordering it last keeps the earlier
+    # configs on the shared un-halved programs (suite-time discipline).
+    configs = [
+        ("NOD", "Flake16", "None", "None", "Decision Tree"),
+        ("NOD", "Flake16", "None", "None", "Extra Trees"),
+        ("NOD", "Flake16", "PCA", "SMOTE", "Extra Trees"),
+        ("OD", "Flake16", "Scaling", "SMOTE", "Extra Trees"),
+        ("OD", "Flake16", "None", "ENN", "Extra Trees"),
+        ("OD", "Flake16", "None", "None", "Extra Trees"),
+    ]
+    k_transient, k_oom = _idx(configs[1]), _idx(configs[5])
+    monkeypatch.setenv(inject.ENV_VAR,
+                       f"{k_transient}:1:transient;{k_oom}:1:oom")
+    monkeypatch.setenv("F16_FAULT_BACKOFF_S", "0")  # no real sleeps
+    run_dir = obs.configure(root=str(sweep_dir / "telemetry"),
+                            heartbeat_s=0)
+    try:
+        scores = write_scores(
+            configs=configs, max_depth=8, tree_overrides=TINY,
+            out_file="scores-drill-a.pkl",
+            progress_out=open("progress-a.log", "w"),
+        )
+    finally:
+        obs.shutdown()
+    assert set(scores) == set(configs)  # zero aborted
+    for v in scores.values():
+        assert isinstance(v, list) and len(v) == 4  # reference schema
+    # the OOM stepped one halving
+    assert ladder.state().halvings == 1
+    # no quarantine sidecar left behind
+    assert not os.path.exists("scores-drill-a.pkl.quarantine.json") or \
+        quarantine.load_sidecar("scores-drill-a.pkl.quarantine.json") == {}
+    # the obs report's fault section shows the transitions
+    manifest, events = obs_report.load_run(run_dir)
+    rep = obs_report.summarize(manifest, events)
+    fa = rep["faults"]
+    assert fa["by_action"].get("retry", 0) >= 2
+    assert fa["by_action"].get("recovered", 0) >= 2
+    assert fa["by_action"].get("degrade", 0) >= 1
+    assert fa["by_class"].get(faults.TRANSIENT_DEVICE, 0) >= 1
+    assert fa["by_class"].get(faults.OOM, 0) >= 1
+    assert not fa["quarantined"]
+    text = obs_report.render(rep)
+    assert "faults:" in text and "retry" in text
+
+
+def test_injected_quarantine_and_resume(sweep_dir, monkeypatch):
+    """Acceptance drill B: one config injected to fail ALL attempts is
+    quarantined (sweep finishes, exit 23, ledger records fault class +
+    attempt history); the other configs produce reference-schema scores;
+    a subsequent resume re-attempts ONLY the quarantined config and
+    clears the sidecar."""
+    monkeypatch.chdir(sweep_dir)
+    # Same (featureset, prep, balancing, model) shapes as drill A's configs
+    # (only the label mode differs): identical HLO, so the compilation
+    # cache serves these fits from drill A's compiles even on a cold run.
+    configs = [
+        ("OD", "Flake16", "PCA", "SMOTE", "Extra Trees"),
+        ("NOD", "Flake16", "Scaling", "SMOTE", "Extra Trees"),
+        ("NOD", "Flake16", "None", "ENN", "Extra Trees"),
+    ]
+    doomed = configs[1]
+    monkeypatch.setenv(inject.ENV_VAR, f"{_idx(doomed)}:*:transient")
+    monkeypatch.setenv("F16_FAULT_BACKOFF_S", "0")
+    out = "scores-drill-b.pkl"
+    sidecar = out + ".quarantine.json"
+
+    plog = open("progress-b.log", "w")
+    with pytest.raises(quarantine.QuarantinedConfigs) as ei:
+        write_scores(configs=configs, max_depth=8, tree_overrides=TINY,
+                     out_file=out, progress_out=plog)
+    plog.close()
+    e = ei.value
+    assert e.code == quarantine.QUARANTINE_EXIT_CODE
+    assert set(e.quarantined) == {doomed}
+    assert set(e.scores) == set(configs) - {doomed}
+
+    # the pickle holds ONLY completed configs, in the reference schema
+    on_disk = pickle.load(open(out, "rb"))
+    assert set(on_disk) == set(configs) - {doomed}
+    for v in on_disk.values():
+        assert isinstance(v, list) and len(v) == 4
+    # the sidecar records class + full attempt history
+    entries = quarantine.load_sidecar(sidecar)
+    assert set(entries) == {doomed}
+    rec = entries[doomed]
+    assert rec["fault_class"] == faults.TRANSIENT_DEVICE
+    assert [a["attempt"] for a in rec["attempts"]] == [1, 2, 3]
+    # the quarantine listing reached the progress log
+    assert "QUARANTINED" in open("progress-b.log").read()
+
+    # resume without the plan: ONLY the quarantined config re-runs
+    monkeypatch.delenv(inject.ENV_VAR)
+    ran = []
+    orig = SweepEngine.run_config
+
+    def counting(self, keys, timings=None):
+        ran.append(tuple(keys))
+        return orig(self, keys, timings)
+
+    monkeypatch.setattr(SweepEngine, "run_config", counting)
+    scores = write_scores(configs=configs, max_depth=8, tree_overrides=TINY,
+                          out_file=out,
+                          progress_out=open("progress-b.log", "a"))
+    assert ran == [doomed]
+    assert set(scores) == set(configs)
+    assert quarantine.load_sidecar(sidecar) == {}

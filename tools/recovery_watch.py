@@ -39,6 +39,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from flake16_framework_tpu.resilience import faults  # noqa: E402
 from flake16_framework_tpu.utils.relay import (  # noqa: E402
     RELAY_PORT as PORT, relay_listener_up,
 )
@@ -97,14 +98,33 @@ def run_stage(name, cmd, timeout, env_extra=None):
         p.wait()
         log("stage %s TIMEOUT after %ds (process group killed)"
             % (name, timeout))
-        return False, ""
+        # The classifier reads this as a transient device fault — a stage
+        # deadline is the watcher's fault envelope (resilience/faults.py).
+        return False, "", "DEADLINE_EXCEEDED: stage %s timeout" % name
     ok = p.returncode == 0
     log("stage %s %s in %.0fs" % (name, "ok" if ok else
                                   "FAILED rc=%d" % p.returncode,
                                   time.time() - t0))
     if not ok:
         log("  stderr tail: " + (err or "")[-300:].replace("\n", " | "))
-    return ok, out
+    return ok, out, err or ""
+
+
+def stage_ok_to_continue(ok, err):
+    """Chain-liveness verdict for a finished stage, routed through the
+    resilience fault classifier: a green stage continues; a failure whose
+    stderr classifies as DETERMINISTIC continues too (the stage tripped on
+    its own bug — the device path is not implicated, and the remaining
+    evidence stages should still run); any device-flavored class
+    (transient / oom / envelope-overrun / relay-down) continues only if
+    the relay listener is still up, else the watcher returns to polling."""
+    if ok:
+        return True
+    fc = faults.classify_message(err or "")
+    log("  fault class: %s" % fc)
+    if fc == faults.DETERMINISTIC:
+        return True
+    return listener_up()
 
 
 def pick_tuned_env(since_pos):
@@ -235,7 +255,7 @@ def chain():
     py = sys.executable
     probe = os.path.join(REPO, "tools", "hw_probe.py")
 
-    ok, _ = run_stage("matmul", [py, probe, "matmul"], 180)
+    ok, _, _ = run_stage("matmul", [py, probe, "matmul"], 180)
     if not ok:
         return False
     # A listener with a CPU-only jax fallback is NOT a recovery: the chain
@@ -255,20 +275,21 @@ def chain():
     # PARITY.json — run before any probe/tune stage; the compile cache from
     # prior sessions makes the bench's warmups cheap, and bench has its own
     # probe + CPU-fallback protocol if the device died since matmul.
-    ok_b, out = run_stage("bench", [py, os.path.join(REPO, "bench.py")], 4200)
+    ok_b, out, err = run_stage("bench", [py, os.path.join(REPO, "bench.py")],
+                               4200)
     persist_bench_json(out, "bench_tpu.json")
-    if not ok_b and not listener_up():
+    if not stage_ok_to_continue(ok_b, err):
         return False
     # Exact-tier seeds FIRST, one bounded run per seed with a per-seed
     # cache checkpoint (tools/exact_seed_cache.py): a wedge mid-tier
     # keeps every completed seed, and the next chain attempt only pays
     # for the missing ones. 6 seeds x ~20 min/seed at round-2 TPU
     # exact-grower rates + slack.
-    ok_x, _ = run_stage(
+    ok_x, _, err = run_stage(
         "exact_seeds",
         [py, os.path.join(REPO, "tools", "exact_seed_cache.py"), "6"], 10800,
     )
-    if not ok_x and not listener_up():
+    if not stage_ok_to_continue(ok_x, err):
         return False
     # parity --full consumes the cache when complete (it asserts loudly on
     # an under-seeded cache, sending the watcher back to polling — the
@@ -284,11 +305,11 @@ def chain():
         # tops up next attempt) instead of recomputing every exact seed
         # inline where a wedge loses them all.
         parity_env["PARITY_OURS_EXACT_CACHE"] = exact_cache
-    ok_p, _ = run_stage(
+    ok_p, _, err = run_stage(
         "parity_full", [py, os.path.join(REPO, "parity.py"), "--full"], 10800,
         env_extra=parity_env,
     )
-    if not ok_p and not listener_up():
+    if not stage_ok_to_continue(ok_p, err):
         return False
     # Attribution probes after the headline numbers are on disk. hw_probe's
     # own default order, minus the matmul the chain already ran; budget =
@@ -300,14 +321,14 @@ def chain():
     probe_log = os.path.join(REPO, "_scratch", "hw_probe.jsonl")
     tune_from = os.path.getsize(probe_log) if os.path.exists(probe_log) else 0
     probe_steps = [s for s in hw_probe_default_steps() if s != "matmul"]
-    ok, _ = run_stage("probe_all", [py, probe] + probe_steps,
-                      600 * len(probe_steps) + 1800)
-    if not ok and not listener_up():
+    ok, _, err = run_stage("probe_all", [py, probe] + probe_steps,
+                           600 * len(probe_steps) + 1800)
+    if not stage_ok_to_continue(ok, err):
         return False
     # 6 tune_hist + 10 tune_shap combos x 600 s worst case each, plus slack
-    ok_tune, _ = run_stage("tune", [py, probe, "tune_hist", "tune_shap"],
-                           12600)
-    if not ok_tune and not listener_up():
+    ok_tune, _, err = run_stage("tune", [py, probe, "tune_hist",
+                                         "tune_shap"], 12600)
+    if not stage_ok_to_continue(ok_tune, err):
         return False  # tunnel died mid-sweep: poll again, retry later
 
     tuned = pick_tuned_env(tune_from)
@@ -316,11 +337,11 @@ def chain():
         # 4200 like the first bench stage: fresh knob combos can miss the
         # compile cache, and probe+worker+reprobe+retry at the 1800 s
         # worker timeout needs ~3900 s worst case.
-        ok_t, out = run_stage("bench_tuned",
-                              [py, os.path.join(REPO, "bench.py")], 4200,
-                              env_extra=tuned)
+        ok_t, out, err = run_stage("bench_tuned",
+                                   [py, os.path.join(REPO, "bench.py")],
+                                   4200, env_extra=tuned)
         persist_bench_json(out, "bench_tpu_tuned.json")
-        if not ok_t and not listener_up():
+        if not stage_ok_to_continue(ok_t, err):
             return False
     run_stage("trace", [py, os.path.join(REPO, "tools", "hw_trace.py"),
                         "fit", "shap", "mfu"], 2400, env_extra=tuned or None)
