@@ -338,9 +338,15 @@ def worker(n_tests, n_trees):
         for keys, res in zip(unit, run_unit(unit)):
             t_fit += res[0] * engine.n_folds
             t_pred += res[1] * engine.n_folds
-            per_config["/".join(keys)] = round(
-                (res[0] + res[1]) * engine.n_folds, 3
-            )
+            # Per-stage walls per config (round 5): gate tolerances can be
+            # per-stage, and a predict regression is no longer hidden
+            # under a fit-dominated total. Fused runs land the combined
+            # wall in "fit" with predict 0.0 (SweepEngine fused mode).
+            per_config["/".join(keys)] = {
+                "fit": round(res[0] * engine.n_folds, 3),
+                "predict": round(res[1] * engine.n_folds, 3),
+                "total": round((res[0] + res[1]) * engine.n_folds, 3),
+            }
     t_scores = time.time() - t0
     # Per-stage record the moment the stage completes: the parent persists
     # it immediately, so a tunnel death during the SHAP stage still leaves
@@ -367,11 +373,16 @@ def worker(n_tests, n_trees):
         pipeline.shap_for_config(keys, feats, labels, **shap_kw)
         print(f"warmed shap {keys[4]}", file=sys.stderr, flush=True)
     t0 = time.time()
+    per_config_shap = {}
     for keys in cfg.SHAP_CONFIGS:
+        tc0 = time.time()
         pipeline.shap_for_config(keys, feats, labels, **shap_kw)
+        per_config_shap["/".join(keys)] = {
+            "shap": round(time.time() - tc0, 3)}
     t_shap = time.time() - t0
     print(json.dumps({
         "stage": "shap", "t_shap": round(t_shap, 3),
+        "per_config_shap_s": per_config_shap,
         "n_tests": n_tests, "n_trees": n_trees, "n_explain": n_explain,
         "bench_fused": engine.fused,
         "backend": jax.default_backend(),
@@ -382,6 +393,7 @@ def worker(n_tests, n_trees):
         "t_scores": round(t_scores, 3), "t_shap": round(t_shap, 3),
         "t_fit": round(t_fit, 3), "t_predict": round(t_pred, 3),
         "per_config_s": per_config,
+        "per_config_shap_s": per_config_shap,
         "dispatch_trees": DISPATCH_TREES,
         "bench_batch": batch_n,
         "bench_fused": engine.fused,
@@ -721,6 +733,7 @@ def main():
             detail.update(
                 t_cpu_shap_s=round(sum(t_base_shap), 2),
                 t_ours_shap_s=sh["t_shap"],
+                per_config_shap_s=sh.get("per_config_shap_s"),
                 shap_speedup=round(sum(t_base_shap) / sh["t_shap"], 3),
                 shap_baseline="native C tree_shap" if shap_which == "cext"
                 else "numpy oracle",
@@ -794,6 +807,7 @@ def main():
         t_ours_fit_s=result.get("t_fit"),
         t_ours_predict_s=result.get("t_predict"),
         per_config_s=result.get("per_config_s"),
+        per_config_shap_s=result.get("per_config_shap_s"),
         dispatch_trees=result.get("dispatch_trees"),
         bench_batch=result.get("bench_batch"),
         bench_fused=result.get("bench_fused"),

@@ -9,14 +9,21 @@
     python -m flake16_framework_tpu shap        # TPU Tree SHAP -> shap.pkl
     python -m flake16_framework_tpu figures     # LaTeX artifacts
 
-plus two extension verbs the reference lacks:
+plus extension verbs the reference lacks:
 
-    python -m flake16_framework_tpu report [RUN_DIR] [--json]
+    python -m flake16_framework_tpu report [RUN_DIR] [--json] [--attrib]
         # render a telemetry run (F16_TELEMETRY=1 during scores/shap/bench)
-        # into per-stage compile/execute walls, throughput, memory peaks
+        # into per-stage compile/execute walls, throughput, memory peaks;
+        # --attrib ranks hot configs/stages and joins kernel costs
+    python -m flake16_framework_tpu trace [RUN_DIR] [--out FILE]
+        # convert a telemetry run into Chrome-trace/Perfetto JSON
+        # (obs/trace.py; load in chrome://tracing or ui.perfetto.dev)
     python -m flake16_framework_tpu lint [PATHS] [--json] [--baseline F]
         # f16lint: JAX/TPU-hygiene static analysis + 216-config grid
         # pre-flight (analysis/); exit 1 on unsuppressed findings
+    python -m flake16_framework_tpu bench --gate [RESULT.json]
+        # regression gate over the committed BENCH_r*.json trajectory
+        # (tools/bench_gate.py); exit 1 naming the regressed metric
 
 Fault tolerance (resilience/): ``scores`` dispatches every config through
 the resilience guard — transient device faults retry with backoff, OOMs
@@ -93,6 +100,27 @@ def main(argv=None):
         from flake16_framework_tpu.obs.report import report_main
 
         report_main(args)
+    elif command == "trace":
+        from flake16_framework_tpu.obs.trace import trace_main
+
+        trace_main(args)
+    elif command == "bench":
+        # Only the gate lives behind the verb; the measurement harness
+        # stays the standalone bench.py (it owns its env/backend setup).
+        if not args or args[0] != "--gate":
+            raise ValueError(
+                "bench verb supports only --gate (run bench.py directly "
+                "for measurements)")
+        import os
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        from bench_gate import gate_main
+
+        code = gate_main(args[1:])
+        if code:
+            raise SystemExit(code)
     elif command == "lint":
         from flake16_framework_tpu.analysis.cli import lint_main
 

@@ -37,7 +37,17 @@ RULES = {r.id: r for r in (
     RuleInfo("O103", WARNING,
              "span name does not match the lowercase dotted convention"
              " ([a-z0-9_.]+)"),
+    RuleInfo("O104", ERROR,
+             "event kind emitted in code but absent from"
+             " schema.EVENT_FIELDS, or declared there but never emitted"
+             " — two-way wire-schema drift"),
 )}
+
+# Kinds whose emitters live OUTSIDE the package lint scope (the default
+# ``lint flake16_framework_tpu/`` paths): bench.py mirrors its stage
+# ledger records as ``stage`` events. Without this, the reverse O104
+# direction would flag a kind that is in fact emitted.
+_EXTERNAL_EMITTERS = frozenset({"stage"})
 
 _SPAN_NAME_RE = re.compile(r"^[a-z0-9_.]+$")
 
@@ -68,6 +78,84 @@ def check_module(mod):
                     f"span name {name!r} does not match "
                     f"{_SPAN_NAME_RE.pattern!r}"))
     return findings
+
+
+def check_project(mods):
+    """O104 — the two-way kind/schema consistency sweep, run once over all
+    linted modules so the emit census is project-wide.
+
+    Forward: a raw event dict literal (``{"kind": "<literal>", ...}`` —
+    the low-level ``_emit``/``append_jsonl`` style that bypasses
+    ``obs.event``'s O102 coverage) whose kind is not declared in
+    schema.EVENT_FIELDS. ``obs.event()`` call kinds are O102's job and
+    only feed the census here, so one drift never fires twice.
+
+    Reverse: a kind declared in schema.EVENT_FIELDS that no linted module
+    emits — dead schema that validators keep accepting. Anchored on the
+    declaration in obs/schema.py and only checked when that module is in
+    the linted set (linting a lone file must not indict the whole
+    schema); kinds with known out-of-scope emitters are allowlisted
+    (_EXTERNAL_EMITTERS)."""
+    emitted = set()
+    dict_literals = []  # (mod, kind-value node, kind)
+    for mod in mods:
+        for node in ast.walk(mod.tree):
+            # Census counts both obs.event("k", ...) and core.py's own
+            # bare event("k", ...) calls.
+            if isinstance(node, ast.Call) \
+                    and ((isinstance(node.func, ast.Attribute)
+                          and node.func.attr == "event")
+                         or (isinstance(node.func, ast.Name)
+                             and node.func.id == "event")) \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                emitted.add(node.args[0].value)
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) and k.value == "kind" \
+                            and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str):
+                        emitted.add(v.value)
+                        dict_literals.append((mod, v, v.value))
+
+    findings = []
+    for mod, node, kind in dict_literals:
+        if kind not in schema.EVENT_FIELDS:
+            findings.append(mod.finding(
+                "O104", RULES["O104"].severity, node,
+                f"event dict literal with kind {kind!r} not declared in "
+                f"schema.EVENT_FIELDS (known: "
+                f"{sorted(schema.EVENT_FIELDS)})"))
+
+    schema_mod = next(
+        (m for m in mods
+         if m.path.replace(os.sep, "/").endswith("obs/schema.py")), None)
+    if schema_mod is not None:
+        for kind in sorted(set(schema.EVENT_FIELDS) - emitted
+                           - _EXTERNAL_EMITTERS):
+            node = _event_fields_key_node(schema_mod.tree, kind)
+            if node is None:
+                continue
+            findings.append(schema_mod.finding(
+                "O104", RULES["O104"].severity, node,
+                f"event kind {kind!r} is declared in schema.EVENT_FIELDS "
+                "but no linted module emits it"))
+    return findings
+
+
+def _event_fields_key_node(tree, kind):
+    """The dict-key node declaring ``kind`` inside schema.py's
+    EVENT_FIELDS literal (the reverse-drift finding's anchor)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name) and t.id == "EVENT_FIELDS"
+                        for t in node.targets) \
+                and isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and k.value == kind:
+                    return k
+    return None
 
 
 # -- emitted-document validation (the old tool's body) ------------------

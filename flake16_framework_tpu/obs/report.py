@@ -145,6 +145,125 @@ def summarize(manifest, events):
     }
 
 
+def summarize_attrib(manifest, events):
+    """The ``--attrib`` view: per-config stage walls joined to kernel
+    costs. Span events carry ``stage`` (fit | predict | fused | shap) and
+    either ``config`` or (batch spans) ``configs``; batch walls are split
+    evenly across the batch's members — the engine's documented
+    amortized-clock convention (SweepEngine.run_config_batch). Sub-stage
+    fields recorded by the chunked fit / staged shap paths refine the
+    split: ``prep_s`` (and shap's ``resample_s``) peel the prep+resample
+    dispatch out of the fit wall into a ``resample`` stage, and shap's
+    ``fit_s``/``explain_s`` separate growth from the explain itself.
+    ``cost`` events aggregate by their ``span`` name (the kernel)."""
+    configs = {}
+    stages = {}
+    kernels = {}
+
+    def charge(config, stage, wall):
+        if wall <= 0:
+            return
+        st = configs.setdefault(config, {})
+        st[stage] = st.get(stage, 0.0) + wall
+        stages[stage] = stages.get(stage, 0.0) + wall
+
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "span" and isinstance(ev.get("wall_s"), (int, float)):
+            stage = ev.get("stage")
+            if stage is None:
+                continue  # pre-attribution spans (scores.run_grid, ...)
+            targets = ev.get("configs") if isinstance(ev.get("configs"),
+                                                      list) else None
+            if targets is None:
+                targets = [ev["config"]] if ev.get("config") else []
+            if not targets:
+                continue
+            share = 1.0 / len(targets)
+            wall = ev["wall_s"]
+            # sub-stage refinements (fields ride on the span)
+            split = []
+            if stage == "fit":
+                prep = ev.get("prep_s")
+                if isinstance(prep, (int, float)):
+                    split = [("resample", prep),
+                             ("fit", max(0.0, wall - prep))]
+            elif stage == "shap":
+                if isinstance(ev.get("fit_s"), (int, float)):
+                    prep = (ev.get("prep_s") or 0.0) + \
+                        (ev.get("resample_s") or 0.0)
+                    split = [("resample", prep), ("fit", ev["fit_s"]),
+                             ("shap", ev.get("explain_s") or
+                              max(0.0, wall - prep - ev["fit_s"]))]
+            if not split:
+                split = [(stage, wall)]
+            for config in targets:
+                for sname, swall in split:
+                    charge(config, sname, swall * share)
+        elif kind == "cost":
+            k = kernels.setdefault(ev.get("span", "?"), {
+                "n": 0, "flops": 0.0, "bytes": 0.0, "compile_s": 0.0,
+                "lower_s": 0.0, "cache_hits": 0, "cache_misses": 0})
+            k["n"] += 1
+            for field in ("flops", "bytes", "compile_s", "lower_s"):
+                if isinstance(ev.get(field), (int, float)):
+                    k[field] += ev[field]
+            for field in ("cache_hits", "cache_misses"):
+                if isinstance(ev.get(field), int):
+                    k[field] += ev[field]
+
+    for st in configs.values():
+        st["total_s"] = round(sum(st.values()), 4)
+        for name in list(st):
+            st[name] = round(st[name], 4)
+    ranked = sorted(configs, key=lambda c: -configs[c]["total_s"])
+    return {
+        "schema": schema.REPORT_SCHEMA + "+attrib",
+        "run": manifest.get("run", "?"),
+        "configs": {c: configs[c] for c in ranked},
+        "stages": {s: round(w, 4) for s, w in
+                   sorted(stages.items(), key=lambda kv: -kv[1])},
+        "kernel_costs": kernels,
+    }
+
+
+def render_attrib(attrib, top=15):
+    """Human-readable ``--attrib`` view of a summarize_attrib() object."""
+    out = [f"run {attrib['run']} — per-config stage attribution"]
+    if attrib["stages"]:
+        out.append("stage totals: " + "  ".join(
+            f"{s}={w:.2f}s" for s, w in attrib["stages"].items()))
+    out.append("")
+    stage_names = list(attrib["stages"]) or ["fit"]
+    configs = attrib["configs"]
+    if configs:
+        hdr = f"{'config':<52}{'total_s':>9}" + "".join(
+            f"{s:>10}" for s in stage_names)
+        out += [hdr, "-" * len(hdr)]
+        for c in list(configs)[:top]:
+            st = configs[c]
+            out.append(f"{c[:52]:<52}{st['total_s']:>9.3f}" + "".join(
+                f"{st.get(s, 0.0):>10.3f}" for s in stage_names))
+        if len(configs) > top:
+            out.append(f"... {len(configs) - top} more configs")
+        out.append("")
+    kernels = attrib["kernel_costs"]
+    if kernels:
+        hdr = (f"{'kernel':<26}{'compiles':>9}{'gflops':>10}{'gbytes':>10}"
+               f"{'compile_s':>11}{'cache h/m':>11}")
+        out += [hdr, "-" * len(hdr)]
+        for name in sorted(kernels, key=lambda k: -kernels[k]["flops"]):
+            k = kernels[name]
+            out.append(
+                f"{name:<26}{k['n']:>9}{k['flops'] / 1e9:>10.3f}"
+                f"{k['bytes'] / 1e9:>10.3f}{k['compile_s']:>11.3f}"
+                f"{k['cache_hits']:>6}/{k['cache_misses']:<4}")
+    if not configs and not kernels:
+        out.append("no attribution data — needs a run with stage-tagged "
+                   "spans (scores/shap under F16_TELEMETRY=1)")
+    return "\n".join(out)
+
+
 def render(report):
     """Human-readable summary of a summarize() object."""
     m = report["manifest"]
@@ -222,12 +341,21 @@ def report_main(args, out=None):
     """CLI entry for the ``report`` verb (``__main__.py``)."""
     out = out or sys.stdout
     as_json = False
+    attrib = False
+    top = 15
     root = None
     path = None
     it = iter(args)
     for a in it:
         if a == "--json":
             as_json = True
+        elif a == "--attrib":
+            attrib = True
+        elif a == "--top":
+            raw = next(it, None)
+            if raw is None:
+                raise ValueError("--top needs a count argument")
+            top = int(raw)
         elif a == "--root":
             root = next(it, None)
             if root is None:
@@ -240,6 +368,14 @@ def report_main(args, out=None):
             raise ValueError(f"Unrecognized report argument {a!r}")
     run_dir = find_run_dir(path, root)
     manifest, events = load_run(run_dir)
+    if attrib:
+        report = summarize_attrib(manifest, events)
+        if as_json:
+            out.write(json.dumps(report, indent=1, default=str) + "\n")
+        else:
+            out.write(f"[{run_dir}]\n" + render_attrib(report, top=top)
+                      + "\n")
+        return report
     report = summarize(manifest, events)
     if as_json:
         out.write(json.dumps(report, indent=1, default=str) + "\n")

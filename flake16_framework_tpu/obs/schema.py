@@ -55,6 +55,15 @@ EVENT_FIELDS = {
     # quarantine | ledger-reset; ``attempt`` is the 1-based attempt the
     # transition happened on (0 where no attempt applies).
     "fault": {"fault_class": str, "action": str, "attempt": int},
+    # One compiled kernel's XLA cost-model charge sheet (obs/costs.py):
+    # emitted at the first lower+compile of a (span, signature) pair.
+    # ``span`` names the span the kernel serves (the attribution join key
+    # for ``report --attrib``); ``flops``/``bytes`` are the cost model's
+    # analytic counts (0.0 when the model is silent, e.g. all-custom-call
+    # programs); ``compile_s`` is the measured compile wall. Extra fields:
+    # ``lower_s``, ``cache_hits``/``cache_misses`` (persistent
+    # compilation-cache events observed during this compile).
+    "cost": {"span": str, "flops": _NUM, "bytes": _NUM, "compile_s": _NUM},
 }
 
 MANIFEST_FIELDS = {

@@ -1,0 +1,199 @@
+"""The ``trace`` CLI verb: convert one telemetry run (events.jsonl +
+manifest.json) into Chrome-trace/Perfetto JSON.
+
+    python -m flake16_framework_tpu trace [RUN_DIR] [--out FILE] \
+        [--root DIR]
+
+Spans become ``X`` (complete) duration events laid out on one lane per
+emitting thread — span events carry ``tid`` since this PR; older logs
+fall back to one lane per span-name family (``scores``, ``shap``, ...).
+Counters and gauges become ``C`` counter tracks, and the point-like kinds
+(fault, heartbeat, profile, stage, cost) become ``i`` instants whose args
+carry the full event, so a 216-config sweep reads as a timeline in
+chrome://tracing or https://ui.perfetto.dev instead of a JSONL scroll.
+
+``summarize_device_trace`` is the trace-summarization half of
+tools/hw_trace.py (top device ops by total duration from a perfetto
+``*.trace.json.gz``, mapped to HLO metadata where present), moved here so
+both the scratch probes and future verbs share one parser; hw_trace.py
+keeps a back-compat shim, the same pattern used when the telemetry drift
+lint absorbed tools/check_telemetry_schema.py.
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+from flake16_framework_tpu.obs import report, schema
+
+# Kinds rendered as point events; everything else schema-known is handled
+# explicitly below.
+_INSTANT_KINDS = ("fault", "heartbeat", "profile", "stage", "cost")
+
+_PID = 1  # single-process runs: one chrome "process" per run
+
+
+def _micros(ts, t0):
+    return max(0.0, (ts - t0) * 1e6)
+
+
+def chrome_trace(manifest, events):
+    """A Chrome-trace object ({"traceEvents": [...]}) for one run."""
+    started = manifest.get("started_ts")
+    ts_all = [e["ts"] for e in events
+              if isinstance(e.get("ts"), (int, float))]
+    t0 = started if isinstance(started, (int, float)) else (
+        min(ts_all) if ts_all else 0.0)
+
+    out = []
+    argv = manifest.get("argv") or []
+    pname = "flake16 " + " ".join(str(a) for a in argv[1:2]) if argv \
+        else "flake16"
+    out.append({"ph": "M", "pid": _PID, "name": "process_name",
+                "args": {"name": pname.strip()}})
+
+    tids = {}  # lane key (thread ident or span family) -> small tid
+
+    def lane(ev):
+        key = ev.get("tid")
+        if key is None:  # pre-tid logs: lane per span-name family
+            key = str(ev.get("name", "?")).split(".")[0]
+        if key not in tids:
+            tids[key] = len(tids) + 1
+            label = f"thread {key}" if isinstance(key, int) else key
+            out.append({"ph": "M", "pid": _PID, "tid": tids[key],
+                        "name": "thread_name", "args": {"name": label}})
+        return tids[key]
+
+    for ev in events:
+        kind = ev.get("kind")
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        if kind == "span" and isinstance(ev.get("wall_s"), (int, float)):
+            # the span event is stamped at exit; start = ts - wall
+            wall = ev["wall_s"]
+            args = {k: v for k, v in ev.items()
+                    if k not in ("kind", "ts", "run", "name", "wall_s",
+                                 "tid")}
+            out.append({"ph": "X", "pid": _PID, "tid": lane(ev),
+                        "ts": _micros(ts - wall, t0),
+                        "dur": wall * 1e6, "cat": "span",
+                        "name": ev.get("name", "?"), "args": args})
+        elif kind == "counter" and isinstance(ev.get("total"),
+                                              (int, float)):
+            out.append({"ph": "C", "pid": _PID, "ts": _micros(ts, t0),
+                        "name": ev.get("name", "?"),
+                        "args": {"total": ev["total"]}})
+        elif kind == "gauge" and isinstance(ev.get("value"), (int, float)):
+            out.append({"ph": "C", "pid": _PID, "ts": _micros(ts, t0),
+                        "name": ev.get("name", "?"),
+                        "args": {"value": ev["value"]}})
+        elif kind in _INSTANT_KINDS:
+            args = {k: v for k, v in ev.items()
+                    if k not in ("kind", "ts", "run")}
+            name = kind if kind != "cost" else \
+                f"cost {ev.get('span', '?')}"
+            out.append({"ph": "i", "pid": _PID, "tid": 0, "s": "p",
+                        "ts": _micros(ts, t0), "cat": kind, "name": name,
+                        "args": args})
+
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "otherData": {"run": manifest.get("run", "?"),
+                          "schema": schema.TELEMETRY_SCHEMA}}
+
+
+def write_trace(run_dir, out_path=None):
+    """Render ``run_dir`` to Chrome-trace JSON at ``out_path`` (default
+    ``<run_dir>/trace.json``); returns (path, trace object)."""
+    manifest, events = report.load_run(run_dir)
+    trace = chrome_trace(manifest, events)
+    out_path = out_path or os.path.join(run_dir, "trace.json")
+    with open(out_path, "w") as fd:
+        json.dump(trace, fd)
+    return out_path, trace
+
+
+def trace_main(args, out=None):
+    """CLI entry for the ``trace`` verb (``__main__.py``)."""
+    out = out or sys.stdout
+    root = None
+    path = None
+    out_path = None
+    it = iter(args)
+    for a in it:
+        if a == "--out":
+            out_path = next(it, None)
+            if out_path is None:
+                raise ValueError("--out needs a file argument")
+        elif a == "--root":
+            root = next(it, None)
+            if root is None:
+                raise ValueError("--root needs a directory argument")
+        elif a.startswith("--"):
+            raise ValueError(f"Unrecognized trace option {a!r}")
+        elif path is None:
+            path = a
+        else:
+            raise ValueError(f"Unrecognized trace argument {a!r}")
+    run_dir = report.find_run_dir(path, root)
+    out_path, trace = write_trace(run_dir, out_path)
+    n = len(trace["traceEvents"])
+    out.write(f"[{run_dir}]\nwrote {out_path} ({n} trace events) — load "
+              "in chrome://tracing or https://ui.perfetto.dev\n")
+    return out_path
+
+
+# -- device-trace summarization (from tools/hw_trace.py) ----------------
+
+
+def summarize_device_trace(trace_dir, top=25, out=None):
+    """Sum device-track slice durations by op name from the newest
+    perfetto trace under ``trace_dir``; prints the top ops and returns
+    the aggregates (None when no trace exists)."""
+    out = out or sys.stdout
+    paths = sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True,
+    ), key=os.path.getmtime)
+    if not paths:
+        out.write(f"no trace found under {trace_dir}\n")
+        return None
+    with gzip.open(paths[-1], "rt") as fd:
+        data = json.load(fd)
+    events = data.get("traceEvents", [])
+    # device tracks: process names containing "TPU" / "Device"
+    pid_name = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_name[e["pid"]] = e["args"].get("name", "")
+    dur_by_name = defaultdict(float)
+    count_by_name = defaultdict(int)
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        pname = pid_name.get(e.get("pid"), "")
+        if not ("TPU" in pname or "Device" in pname or "/device" in pname):
+            continue
+        d = float(e.get("dur", 0.0))
+        name = e.get("name", "?")
+        dur_by_name[name] += d
+        count_by_name[name] += 1
+        total += d
+    ranked = sorted(dur_by_name.items(), key=lambda kv: -kv[1])
+    out.write(f"trace: {paths[-1]}\n")
+    out.write(f"device total: {total / 1e6:.3f} s over "
+              f"{sum(count_by_name.values())} slices\n")
+    for name, d in ranked[:top]:
+        out.write(f"{d / 1e6:9.3f} s  x{count_by_name[name]:<5d} "
+                  f"{name[:100]}\n")
+    return {
+        "trace": paths[-1],
+        "total_s": total / 1e6,
+        "slices": sum(count_by_name.values()),
+        "top": [{"name": n_, "dur_s": d / 1e6,
+                 "count": count_by_name[n_]} for n_, d in ranked[:top]],
+    }

@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from flake16_framework_tpu.obs import costs as _costs
 from flake16_framework_tpu.resilience import ladder as _res_ladder
 
 # sklearn's FEATURE_THRESHOLD: two values closer than this are "equal" for
@@ -1084,3 +1085,20 @@ def predict(forest, x):
     """Binary predict: class 1 iff p1 > p0 (argmax tie -> class 0, like np.argmax)."""
     p = predict_proba(forest, x)
     return p[:, 1] > p[:, 0]
+
+
+# Cost attribution (obs/costs.py): host-level dispatches of the grower and
+# predict entry points emit ``cost`` events; calls from inside an enclosing
+# jit trace (the sweep's fused programs) pass through untouched.
+fit_forest_hist = _costs.instrument(
+    fit_forest_hist, "trees.fit_forest_hist",
+    static_argnames=("n_trees", "bootstrap", "random_splits",
+                     "sqrt_features", "max_depth", "max_nodes",
+                     "tree_chunk", "n_bins", "hist_impl"))
+fit_forest = _costs.instrument(
+    fit_forest, "trees.fit_forest",
+    static_argnames=("n_trees", "bootstrap", "random_splits",
+                     "sqrt_features", "max_depth", "max_nodes",
+                     "tree_chunk"))
+predict_proba = _costs.instrument(predict_proba, "trees.predict_proba",
+                                  static_argnames=("impl",))

@@ -30,6 +30,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from flake16_framework_tpu.obs import costs as _costs
 from flake16_framework_tpu.ops.trees import slice_trees, trim_nodes
 from flake16_framework_tpu.resilience import ladder as _ladder
 
@@ -586,6 +587,17 @@ def _pallas_forest_shap(forest, x, *, depth, interpret):
     )(n_leaves, sf, sthr, sratio, sleft, svalid, leaf_p0, leaf_ok, xt)
 
     return out[:n_features, :s].T / t
+
+
+# Cost attribution (obs/costs.py): the two explain programs are the SHAP
+# stage's compiled kernels; the driver (forest_shap_class0) dispatches them
+# from host, so the wrapper sees concrete arrays and can AOT-compile.
+_xla_forest_shap = _costs.instrument(
+    _xla_forest_shap, "shap.xla_forest",
+    static_argnames=("depth", "sample_chunk"))
+_pallas_forest_shap = _costs.instrument(
+    _pallas_forest_shap, "shap.pallas_forest",
+    static_argnames=("depth", "interpret"))
 
 
 def expected_p0(forest):

@@ -29,6 +29,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from flake16_framework_tpu import config as cfg, obs
+from flake16_framework_tpu.obs import costs
 from flake16_framework_tpu.ops.metrics import confusion_by_project, format_scores
 from flake16_framework_tpu.ops.preprocess import fit_preprocess, transform
 from flake16_framework_tpu.ops.resample import resample
@@ -214,7 +215,13 @@ def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
         spec, n=n, n_projects=n_projects, cap=cap, max_depth=max_depth,
         n_folds=n_folds, tree_chunk=tree_chunk, grower=grower,
     )
-    return tuple(jax.jit(f) for f in fns)
+    # Cost attribution (obs/costs.py): each jitted entry point's compiles
+    # emit a ``cost`` event named for the kernel — transparent passthrough
+    # when telemetry is off.
+    names = ("scores.fit", "scores.score", "scores.prep",
+             "scores.fit_chunk", "scores.tree_keys", "scores.config")
+    return tuple(costs.instrument(jax.jit(f), nm)
+                 for f, nm in zip(fns, names))
 
 
 def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
@@ -286,7 +293,7 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
     # Replicated data arrays mix with config-varying codes inside
     # lax.switch; jax 0.9's varying-manual-axes validator rejects
     # that conservatively (its own error message says to disable).
-    def smap(f, in_specs, out_specs):
+    def smap(f, in_specs, out_specs, name):
         try:
             sm = jax.shard_map(f, mesh=mesh, in_specs=in_specs,
                                out_specs=out_specs, check_vma=False)
@@ -297,19 +304,24 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
 
             sm = shard_map_fn(f, mesh=mesh, in_specs=in_specs,
                               out_specs=out_specs, check_rep=False)
-        return jax.jit(sm)
+        # ``name`` tags the SPMD program's compile-cost events
+        # (obs/costs.py) with the kernel it serves.
+        return costs.instrument(jax.jit(sm), name)
 
     fit_b = smap(fit_batch, (P(), P(), pspec, pspec, pspec, pspec, pspec),
-                 (forest_specs, pspec, pspec))
+                 (forest_specs, pspec, pspec), "scores.fit_batch")
     prep_b = smap(prep_batch, (P(), P(), pspec, pspec, pspec, pspec, pspec),
-                  (pspec, pspec, pspec, pspec, pspec, pspec))
+                  (pspec, pspec, pspec, pspec, pspec, pspec),
+                  "scores.prep_batch")
     fit_chunk_b = smap(fit_chunk_batch,
-                       (pspec, pspec, pspec, pspec, pspec), forest_specs)
-    tree_keys_b = smap(tree_keys_batch, (pspec,), pspec)
+                       (pspec, pspec, pspec, pspec, pspec), forest_specs,
+                       "scores.fit_chunk_batch")
+    tree_keys_b = smap(tree_keys_batch, (pspec,), pspec,
+                       "scores.tree_keys_batch")
     score_b = smap(score_batch, (forest_specs, pspec, pspec, pspec, P()),
-                   pspec)
+                   pspec, "scores.score_batch")
     all_b = smap(all_batch, (P(), P(), pspec, pspec, pspec, pspec, pspec,
-                             pspec, P()), pspec)
+                             pspec, P()), pspec, "scores.config_batch")
     return fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b, all_b
 
 
@@ -582,7 +594,8 @@ class SweepEngine:
         family = (fs_name, model_name)
         if self.fused and timings is None:
             with obs.span("scores.config", key=(*family, "fused"),
-                          mode="fused", config="/".join(config_keys)):
+                          mode="fused", stage="fused",
+                          config="/".join(config_keys)):
                 t0 = time.time()
                 counts = np.asarray(cv_all(  # np.asarray blocks on the result
                     *fit_args, jnp.asarray(test_mask),
@@ -596,15 +609,23 @@ class SweepEngine:
             )
             return [wall / self.n_folds, 0.0, scores, scores_total]
 
-        with obs.span("scores.fit", key=(*family, "staged"),
-                      config="/".join(config_keys)):
+        with obs.span("scores.fit", key=(*family, "staged"), stage="fit",
+                      config="/".join(config_keys)) as fit_sp:
             t0 = time.time()
             if dc is not None or df is not None:
+                # Telemetry-on runs get the sub-stage split (prep/resample
+                # vs tree growth) even without an explicit timings dict —
+                # the documented extra syncs of timed mode apply
+                # (_chunked_fit; ``report --attrib`` reads the fields).
+                sub = timings if timings is not None else (
+                    {} if obs.enabled() else None)
                 forest, xp, y = _chunked_fit(
                     cv_prep, cv_fit_chunk, lambda: cv_tree_keys(key),
                     fit_args, n_trees, dc, tree_axis=1, fold_chunk=df,
-                    timings=timings,
+                    timings=sub,
                 )
+                if sub:
+                    fit_sp.add(**sub)
             else:
                 forest, xp, y = cv_fit(*fit_args)
                 jax.block_until_ready(forest)
@@ -613,7 +634,7 @@ class SweepEngine:
             timings["fit_total_s"] = round(t_train, 4)
 
         with obs.span("scores.score", key=(*family, "staged"),
-                      config="/".join(config_keys)):
+                      stage="predict", config="/".join(config_keys)):
             t0 = time.time()
             counts = cv_score(
                 forest, xp, y, jnp.asarray(test_mask),
@@ -704,9 +725,11 @@ class SweepEngine:
         dc, df = self._dispatch_bounds(n_trees)
 
         family = (fs_name, model_name)
+        configs_field = ["/".join(k) for k in config_batch]
         if self.fused:
             with obs.span("scores.config_batch", key=(*family, "fused", b),
-                          mode="fused", batch=len(config_batch)):
+                          mode="fused", stage="fused",
+                          batch=len(config_batch), configs=configs_field):
                 t0 = time.time()
                 counts = np.asarray(all_b(
                     *fit_args, jnp.asarray(tems),
@@ -725,7 +748,8 @@ class SweepEngine:
             return out
 
         with obs.span("scores.fit_batch", key=(*family, "staged", b),
-                      batch=len(config_batch)):
+                      stage="fit", batch=len(config_batch),
+                      configs=configs_field):
             t0 = time.time()
             if dc is not None or df is not None:
                 # Same dispatch-bounding as run_config, but SPMD over the
@@ -745,7 +769,8 @@ class SweepEngine:
             t_train = (time.time() - t0) / len(config_batch)
 
         with obs.span("scores.score_batch", key=(*family, "staged", b),
-                      batch=len(config_batch)):
+                      stage="predict", batch=len(config_batch),
+                      configs=configs_field):
             t0 = time.time()
             counts = score_b(forest, xp, y, jnp.asarray(tems),
                              jnp.asarray(self.project_ids))

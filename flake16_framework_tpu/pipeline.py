@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from flake16_framework_tpu import config as cfg, obs
+from flake16_framework_tpu.obs import costs as _costs
 from flake16_framework_tpu.constants import (
     LOPO_SCORES_FILE, SCORES_FILE, SHAP_FILE, TESTS_FILE,
 )
@@ -223,7 +224,7 @@ def _fused_shap_fit(n, spec, max_depth, max_nodes, use_hist):
                   else trees.fit_forest)(xs, ys, ws, kf, **kw)
         return xp, forest
 
-    return jax.jit(f)
+    return _costs.instrument(jax.jit(f), "shap.fused_fit")
 
 
 def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
@@ -264,7 +265,7 @@ def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
     key = jax.random.PRNGKey(seed)
     if fused_fit and timings is None:
         with obs.span("shap.config", key=(spec.name, "fused"), mode="fused",
-                      config="/".join(config_keys)):
+                      stage="shap", config="/".join(config_keys)):
             fit_fn = _fused_shap_fit(n, spec, max_depth, 4 * n,
                                      spec.n_trees > 1)
             xp, forest = fit_fn(x, y, prep, bal, key)
@@ -280,8 +281,13 @@ def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
     # Staged path: one telemetry span covers the whole config (the final
     # np.asarray blocks on everything, so its wall is the true config
     # wall); in timed mode the per-stage attribution rides as span fields.
+    # Telemetry-on runs get the per-stage split without an explicit
+    # timings dict — the documented extra syncs of timed mode apply
+    # (``report --attrib`` reads the fields off the span).
+    if timings is None and obs.enabled():
+        timings = {}
     with obs.span("shap.config", key=(spec.name, "staged"), mode="staged",
-                  config="/".join(config_keys)) as _span:
+                  stage="shap", config="/".join(config_keys)) as _span:
         t0 = time.time()
         mu, wmat = jax.jit(fit_preprocess)(x, prep)
         xp = transform(x, mu, wmat)

@@ -51,6 +51,8 @@ def debug_leftovers(xs):
 def telemetry_drift():
     with obs.span("Bad Span Name"):                # expect O103
         obs.event("made_up_kind", x=1)             # expect O102
+    rec = {"kind": "invented_kind", "ts": 0.0}     # expect O104
+    obs.append_jsonl("/tmp/raw.jsonl", rec)
 
 
 def unguarded_dispatch(x):

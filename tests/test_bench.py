@@ -41,10 +41,16 @@ def test_bench_json_contract_cpu_fallback():
     d = out["detail"]
     assert d["backend"] == "cpu"
     assert d["tpu_probe"] == "disabled"
-    # Every probe config has an end-to-end time (all three model families).
+    # Every probe config has an end-to-end time (all three model families),
+    # now split by stage: {fit, predict, total} per config.
     assert len(d["per_config_s"]) == 6
-    assert all(v > 0 for v in d["per_config_s"].values())
+    for v in d["per_config_s"].values():
+        assert v["total"] > 0
+        assert v["total"] + 1e-3 >= max(v["fit"], v["predict"])
     assert d["t_ours_shap_s"] > 0 and d["t_cpu_shap_s"] > 0
+    # shap's per-config walls ride in their own table (the shap configs
+    # are not among the 6 probe configs)
+    assert all(v["shap"] > 0 for v in d["per_config_shap_s"].values())
 
 
 def test_watcher_cached_tpu_line_preferred_and_bounded(tmp_path, monkeypatch):
@@ -124,6 +130,105 @@ def test_cached_reemission_is_not_reused_or_repersisted(tmp_path, monkeypatch):
     real = dict(replay, value=11.0, detail={"backend": "tpu"})
     recovery_watch.persist_bench_json(json.dumps(real), "bench_tpu.json")
     assert json.loads((scratch / "bench_tpu.json").read_text())["value"] == 11.0
+
+
+# -- bench regression gate (tools/bench_gate.py) ------------------------
+
+
+def _gate_mod():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_gate
+    finally:
+        sys.path.pop(0)
+    return bench_gate
+
+
+def _rec(n, value, metric="m", unit="u", baseline="b", **detail):
+    detail.setdefault("shap_baseline", baseline)
+    return {"n": n, "parsed": {"metric": metric, "value": value,
+                               "unit": unit, "detail": detail}}
+
+
+def test_gate_passes_within_tolerance():
+    bg = _gate_mod()
+    hist = [_rec(1, 1.0, t_ours_scores_s=10.0,
+                 per_config_s={"A": {"fit": 1.0, "total": 1.2}})]
+    cur = _rec(2, 0.9, t_ours_scores_s=12.0,
+               per_config_s={"A": {"fit": 1.5, "total": 1.8}})
+    res = bg.gate(cur, hist)
+    assert res["passed"], res["failures"]
+    assert {c["metric"] for c in res["checks"]} == {
+        "value", "t_ours_scores_s", "per_config_s[A].fit",
+        "per_config_s[A].total"}
+
+
+def test_gate_fails_naming_the_regressed_metrics():
+    bg = _gate_mod()
+    hist = [_rec(1, 1.0, t_ours_scores_s=10.0)]
+    cur = _rec(2, 0.1, t_ours_scores_s=99.0)  # halved speedup + wall blowup
+    res = bg.gate(cur, hist)
+    assert not res["passed"]
+    named = " ".join(res["failures"])
+    assert "value" in named and "t_ours_scores_s" in named
+
+
+def test_gate_respects_baseline_discontinuity():
+    """An entry whose (metric, unit, shap_baseline) triple matches no
+    predecessor — the r02->r03 SHAP-baseline switch — passes vacuously
+    with a note instead of failing against an incommensurable number."""
+    bg = _gate_mod()
+    hist = [_rec(1, 15.0, baseline="numpy oracle")]
+    cur = _rec(2, 1.0, baseline="native C tree_shap")
+    res = bg.gate(cur, hist)
+    assert res["passed"] and res["ref"] is None
+    assert any("baseline-discontinuity" in n for n in res["notes"])
+    # and gates against the LAST comparable entry, skipping across it
+    hist.append(_rec(3, 1.1, baseline="native C tree_shap"))
+    res = bg.gate(cur, hist)
+    assert res["ref"] is not None and res["passed"]
+
+
+def test_gate_tolerates_legacy_scalar_per_config():
+    bg = _gate_mod()
+    hist = [_rec(1, 1.0, per_config_s={"A": 1.0})]          # old scalar
+    cur = _rec(2, 1.0, per_config_s={"A": {"total": 5.0}})  # new dict
+    res = bg.gate(cur, hist)
+    assert not res["passed"]
+    assert "per_config_s[A].total" in res["failures"][0]
+
+
+def test_gate_cli_on_committed_history_and_doctored_result(tmp_path):
+    """The CI smoke: the committed BENCH_r*.json trajectory gates clean
+    through the real CLI verb; a doctored regression exits 1 naming the
+    metric."""
+    r = subprocess.run(
+        [sys.executable, "-m", "flake16_framework_tpu", "bench", "--gate"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr[-500:]
+    assert "bench gate: PASS" in r.stdout
+
+    bg = _gate_mod()
+    hist = bg.load_history()
+    assert hist, "no committed BENCH_r*.json?"
+    bad = json.loads(json.dumps(hist[-1]))  # deep copy, drop _path via json
+    bad.pop("_path", None)
+    bad["parsed"]["value"] = 0.001
+    doctored = tmp_path / "doctored.json"
+    doctored.write_text(json.dumps(bad))
+    r = subprocess.run(
+        [sys.executable, "-m", "flake16_framework_tpu", "bench", "--gate",
+         str(doctored)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "REGRESSION value" in r.stdout
+    assert "bench gate: FAIL" in r.stdout
+
+    # bare verb rejects anything but --gate
+    r = subprocess.run(
+        [sys.executable, "-m", "flake16_framework_tpu", "bench"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode != 0
 
 
 def test_stage_ledger_assembly_when_device_unreachable(tmp_path, monkeypatch,

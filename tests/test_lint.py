@@ -31,7 +31,7 @@ from flake16_framework_tpu.obs import schema  # noqa: E402
 
 EXPECTED_FIXTURE_RULES = {
     "J101", "J102", "J103", "J104", "J201", "J202", "J203", "J301",
-    "J401", "J402", "J501", "O102", "O103",
+    "J401", "J402", "J501", "O102", "O103", "O104",
 }
 
 
@@ -225,6 +225,35 @@ def test_span_collision_detected():
                 if f.rule == "G105"]
     assert len(findings) == 1
     assert "scores.fit" in findings[0].message
+
+
+def test_o104_reverse_flags_dead_schema_kind(monkeypatch, tmp_path):
+    """A kind declared in schema.EVENT_FIELDS that no linted module emits
+    is dead schema — the reverse O104 direction, anchored on the
+    declaration inside obs/schema.py."""
+    from flake16_framework_tpu.analysis import rules_obs
+
+    monkeypatch.setitem(schema.EVENT_FIELDS, "ghost_kind", {})
+    obs_dir = tmp_path / "obs"
+    obs_dir.mkdir()
+    fake = obs_dir / "schema.py"
+    fake.write_text('EVENT_FIELDS = {"ghost_kind": {"ts": float}}\n')
+    findings = [f for f in rules_obs.check_project([Module(str(fake))])
+                if f.rule == "O104"]
+    assert len(findings) == 1
+    assert "ghost_kind" in findings[0].message
+    assert findings[0].path.endswith("schema.py")
+
+
+def test_o104_reverse_silent_without_schema_module(monkeypatch):
+    """Linting a lone file must not indict the whole schema: the reverse
+    direction only runs when obs/schema.py itself is in the linted set."""
+    from flake16_framework_tpu.analysis import rules_obs
+
+    monkeypatch.setitem(schema.EVENT_FIELDS, "ghost_kind", {})
+    mod = Module("lone.py", src="x = 1\n")
+    assert [f for f in rules_obs.check_project([mod])
+            if f.rule == "O104"] == []
 
 
 def test_analysis_never_imports_jax():

@@ -323,6 +323,115 @@ def test_schema_lint_validates_report_json_file(tmp_path):
     assert any("missing required field 'spans'" in p for p in problems)
 
 
+# -- chrome trace -------------------------------------------------------
+
+
+def test_trace_round_trip(tmp_path):
+    """write_trace renders a run into loadable Chrome-trace JSON whose
+    duration events match the span log one-for-one."""
+    from flake16_framework_tpu.obs import trace
+
+    d = _synthesize_run(tmp_path)
+    path, obj = trace.write_trace(d)
+    assert path == os.path.join(d, "trace.json")
+    with open(path) as fd:
+        loaded = json.load(fd)
+    assert loaded == obj  # round-trips through the file
+
+    evs = _events(d)
+    spans = [e for e in evs if e["kind"] == "span"]
+    xs = [t for t in obj["traceEvents"] if t.get("ph") == "X"]
+    assert len(xs) == len(spans) == 6
+    for sp, x in zip(spans, xs):
+        assert x["name"] == sp["name"] and x["cat"] == "span"
+        assert x["dur"] == pytest.approx(sp["wall_s"] * 1e6)
+        assert x["ts"] >= 0
+    # counters + gauges become counter tracks, heartbeat an instant
+    cs = [t for t in obj["traceEvents"] if t.get("ph") == "C"]
+    assert {t["name"] for t in cs} >= {"configs", "folds",
+                                       "host_rss_peak_mb"}
+    inst = [t for t in obj["traceEvents"] if t.get("ph") == "i"]
+    assert any(t["cat"] == "heartbeat" for t in inst)
+    # lane metadata names every tid used by a duration event
+    named = {t["tid"] for t in obj["traceEvents"]
+             if t.get("ph") == "M" and t["name"] == "thread_name"}
+    assert {x["tid"] for x in xs} <= named
+
+
+def test_trace_verb_cli(tmp_path):
+    from flake16_framework_tpu.obs import trace
+
+    d = _synthesize_run(tmp_path)
+    out_file = str(tmp_path / "custom.json")
+    buf = io.StringIO()
+    path = trace.trace_main([str(d), "--out", out_file], out=buf)
+    assert path == out_file
+    assert "perfetto" in buf.getvalue()
+    assert json.load(open(out_file))["traceEvents"]
+    with pytest.raises(ValueError, match="Unrecognized trace option"):
+        trace.trace_main(["--frobnicate"])
+
+
+def test_trace_lane_fallback_for_pre_tid_logs(tmp_path):
+    """Older event logs (no tid on spans) get one lane per span-name
+    family instead of crashing."""
+    from flake16_framework_tpu.obs import trace
+
+    d = _synthesize_run(tmp_path)
+    evs = _events(d)
+    for e in evs:
+        e.pop("tid", None)
+    obj = trace.chrome_trace({"run": "r", "started_ts": 0.0}, evs)
+    lanes = {t["args"]["name"] for t in obj["traceEvents"]
+             if t.get("ph") == "M" and t["name"] == "thread_name"}
+    assert lanes == {"scores"}
+
+
+# -- cost attribution ----------------------------------------------------
+
+
+def test_attrib_ranks_configs_and_joins_kernels(tmp_path):
+    d = obs.configure(root=str(tmp_path), heartbeat_s=0)
+    with obs.span("scores.config", stage="fused", config="A") as sp:
+        time.sleep(0.03)
+    with obs.span("scores.config", stage="fused", config="B"):
+        time.sleep(0.01)
+    # batch wall split evenly across members (amortized convention)
+    with obs.span("scores.score_batch", stage="predict",
+                  configs=["A", "B"]):
+        time.sleep(0.02)
+    # chunked-fit refinement: prep_s peels a resample stage out
+    with obs.span("scores.fit", stage="fit", config="A") as sp:
+        time.sleep(0.02)
+        sp.add(prep_s=0.005)
+    obs.event("cost", span="scores.fit_chunk", flops=2e9, bytes=1e8,
+              compile_s=0.5, cache_hits=0, cache_misses=1)
+    obs.event("cost", span="scores.fit_chunk", flops=2e9, bytes=1e8,
+              compile_s=0.4, cache_hits=1, cache_misses=0)
+    obs.shutdown()
+
+    manifest, events = report.load_run(d)
+    at = report.summarize_attrib(manifest, events)
+    assert list(at["configs"])[0] == "A"  # ranked by total wall, desc
+    a, b = at["configs"]["A"], at["configs"]["B"]
+    assert a["total_s"] > b["total_s"]
+    assert a["resample"] == pytest.approx(0.005, abs=1e-3)
+    # the batch span's wall is split evenly across A and B
+    assert a["predict"] == pytest.approx(b["predict"], rel=0.5)
+    assert set(at["stages"]) == {"fused", "predict", "fit", "resample"}
+    k = at["kernel_costs"]["scores.fit_chunk"]
+    assert k["n"] == 2 and k["flops"] == 4e9
+    assert k["cache_hits"] == 1 and k["cache_misses"] == 1
+    assert k["compile_s"] == pytest.approx(0.9)
+    # renders without crashing and names the pieces
+    text = report.render_attrib(at)
+    assert "scores.fit_chunk" in text and "A" in text
+    buf = io.StringIO()
+    rep = report.report_main([str(d), "--attrib", "--top", "1"], out=buf)
+    assert rep["schema"].endswith("+attrib")
+    assert "more configs" in buf.getvalue()  # --top truncation note
+
+
 # -- end to end through the scores pipeline -----------------------------
 
 
@@ -366,3 +475,38 @@ def test_scores_run_is_reportable_end_to_end(tmp_path, monkeypatch):
     # and the human rendering names the key sections
     text = report.render(rep)
     assert "compile_s" in text and "execute_s" in text
+
+    # cost events: every lowered kernel reported nonzero flops + a
+    # compile wall (XLA cost_analysis through obs.costs.instrument)
+    costs = [e for e in events if e["kind"] == "cost"]
+    assert costs, "no cost events — instrumented dispatch never fired"
+    assert any(e["flops"] > 0 for e in costs), costs
+    assert all(e["compile_s"] >= 0 and e["bytes"] >= 0 for e in costs)
+    assert any(e["span"].startswith("scores.") for e in costs)
+
+    # manifest is enriched at shutdown with the compilation-cache view
+    assert "jax_cache_dir" in manifest
+    assert manifest["jax_cache_hits"] >= 0
+    assert manifest["jax_cache_misses"] >= 0
+
+    # the trace verb renders the same run: every sweep span is present
+    from flake16_framework_tpu.obs import trace
+
+    buf = io.StringIO()
+    out_path = trace.trace_main([run_dir], out=buf)
+    tr = json.load(open(out_path))
+    xs = [t for t in tr["traceEvents"] if t.get("ph") == "X"]
+    span_evs = [e for e in events if e["kind"] == "span"]
+    assert len(xs) == len(span_evs)
+    assert {x["name"] for x in xs} == {e["name"] for e in span_evs}
+    assert any(t.get("cat") == "cost" for t in tr["traceEvents"])
+
+    # --attrib ranks both configs with stage walls joined to kernel costs
+    at = report.summarize_attrib(manifest, events)
+    assert len(at["configs"]) == 2
+    for st in at["configs"].values():
+        assert st["total_s"] > 0
+    walls = [st["total_s"] for st in at["configs"].values()]
+    assert walls == sorted(walls, reverse=True)
+    assert at["stages"]
+    assert any(k["flops"] > 0 for k in at["kernel_costs"].values())

@@ -1,0 +1,205 @@
+"""Bench regression gate: compare a bench result against the committed
+BENCH_r*.json trajectory with per-metric tolerances.
+
+    python tools/bench_gate.py [RESULT.json] [--json]
+    python -m flake16_framework_tpu bench --gate [RESULT.json] [--json]
+
+With no RESULT.json the LATEST committed entry is gated against its
+predecessors — the CI smoke that keeps the committed trajectory
+internally consistent. With one, that result (either a full BENCH_r
+record or just its ``parsed`` object) is gated against the whole
+committed history — the pre-commit check for a fresh bench run.
+
+Comparability: entries are only compared within a run of the SAME
+(metric, unit, shap baseline) triple — BENCH_r03's baseline_note marks
+the r02->r03 discontinuity (the SHAP baseline switched from a numpy
+oracle to compiled C, ~15x faster; speedups across that line mean
+nothing), and r01 measures a different probe entirely. A result with no
+comparable predecessor passes vacuously with a ``baseline-discontinuity``
+note instead of failing against an incommensurable number.
+
+Tolerances are deliberately loose — the bench runs on shared CI hosts
+and the committed values span backends — so the gate catches
+regressions in KIND (a 2x wall blowup, a halved speedup), not noise:
+
+- headline speedups (``value``, ``scores_speedup``, ``shap_speedup``)
+  must stay >= ``RATIO_FLOOR`` x the reference;
+- our walls (``t_ours_scores_s``, ``t_ours_shap_s``) must stay <=
+  ``RATIO_CEIL`` x the reference (baseline walls are the CPU stack's
+  problem, not ours — not gated);
+- per-config walls (``per_config_s``) are gated per shared config at
+  ``PER_CONFIG_CEIL`` (noisier: single-config timings), tolerating both
+  the round-5 dict form ({fit, predict, total}) and older scalars.
+
+Exit status: 0 = within tolerance, 1 = regression (every failed metric
+is named on stdout), 2 = usage/IO error.
+"""
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RATIO_FLOOR = 0.65   # higher-is-better metrics: cur >= floor * ref
+RATIO_CEIL = 1.75    # lower-is-better walls:    cur <= ceil * ref
+PER_CONFIG_CEIL = 2.0
+
+HIGHER_BETTER = ("value", "scores_speedup", "shap_speedup")
+LOWER_BETTER = ("t_ours_scores_s", "t_ours_shap_s")
+
+
+def load_history(repo=REPO):
+    """Committed BENCH_r*.json records, sorted by round number ``n``."""
+    entries = []
+    for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        try:
+            with open(path) as fd:
+                rec = json.load(fd)
+        except (OSError, ValueError):
+            continue
+        if isinstance(rec, dict) and isinstance(rec.get("parsed"), dict):
+            rec["_path"] = path
+            entries.append(rec)
+    return sorted(entries, key=lambda r: r.get("n", 0))
+
+
+def _parsed(rec):
+    """The parsed-metric object of a record (full BENCH_r schema or an
+    already-bare parsed object)."""
+    if "parsed" in rec and isinstance(rec["parsed"], dict):
+        return rec["parsed"]
+    return rec
+
+
+def comparability_key(rec):
+    p = _parsed(rec)
+    detail = p.get("detail") or {}
+    return (p.get("metric"), p.get("unit"), detail.get("shap_baseline"))
+
+
+def _metric(rec, name):
+    p = _parsed(rec)
+    if name == "value":
+        v = p.get("value")
+    else:
+        v = (p.get("detail") or {}).get(name)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _config_stages(v):
+    """Normalize one per_config_s value to {stage: wall}: the round-5 dict
+    form passes through, older scalars become {"total": v}."""
+    if isinstance(v, dict):
+        return {k: float(w) for k, w in v.items()
+                if isinstance(w, (int, float))}
+    if isinstance(v, (int, float)):
+        return {"total": float(v)}
+    return {}
+
+
+def gate(current, history):
+    """Compare ``current`` against the last comparable ``history`` entry.
+    Returns {"passed", "checks", "failures", "notes", "ref"}."""
+    key = comparability_key(current)
+    ref = None
+    for rec in history:
+        if comparability_key(rec) == key:
+            ref = rec
+    notes = []
+    checks = []
+    failures = []
+    if ref is None:
+        notes.append(
+            "baseline-discontinuity: no committed entry shares "
+            f"(metric, unit, shap_baseline)={key!r}; nothing to gate "
+            "against (see BENCH_r03 baseline_note)")
+        return {"passed": True, "checks": checks, "failures": failures,
+                "notes": notes, "ref": None}
+
+    def check(name, cur, refv, ok, limit):
+        checks.append({"metric": name, "current": cur, "ref": refv,
+                       "limit": round(limit, 4), "ok": ok})
+        if not ok:
+            failures.append(
+                f"{name}: {cur} vs ref {refv} (limit {limit:.4g})")
+
+    for name in HIGHER_BETTER:
+        cur, refv = _metric(current, name), _metric(ref, name)
+        if cur is None or refv is None:
+            continue
+        limit = RATIO_FLOOR * refv
+        check(name, cur, refv, cur >= limit, limit)
+    for name in LOWER_BETTER:
+        cur, refv = _metric(current, name), _metric(ref, name)
+        if cur is None or refv is None:
+            continue
+        limit = RATIO_CEIL * refv
+        check(name, cur, refv, cur <= limit, limit)
+
+    for table in ("per_config_s", "per_config_shap_s"):
+        cur_pc = (_parsed(current).get("detail") or {}).get(table)
+        ref_pc = (_parsed(ref).get("detail") or {}).get(table)
+        if not (isinstance(cur_pc, dict) and isinstance(ref_pc, dict)):
+            continue
+        for config in sorted(set(cur_pc) & set(ref_pc)):
+            cs, rs = _config_stages(cur_pc[config]), \
+                _config_stages(ref_pc[config])
+            for stage in sorted(set(cs) & set(rs)):
+                if rs[stage] <= 0:
+                    continue
+                limit = PER_CONFIG_CEIL * rs[stage]
+                check(f"{table}[{config}].{stage}", cs[stage],
+                      rs[stage], cs[stage] <= limit, limit)
+
+    if not checks:
+        notes.append("no shared metrics with the reference entry — "
+                     "vacuous pass")
+    return {"passed": not failures, "checks": checks,
+            "failures": failures, "notes": notes,
+            "ref": ref.get("_path", f"n={ref.get('n')}")}
+
+
+def gate_main(argv=None, out=None):
+    out = out or sys.stdout
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if any(a.startswith("--") for a in argv) or len(argv) > 1:
+        out.write(__doc__.split("\n\n")[1] + "\n")
+        return 2
+
+    history = load_history()
+    if argv:
+        try:
+            with open(argv[0]) as fd:
+                current = json.load(fd)
+        except (OSError, ValueError) as e:
+            out.write(f"cannot read result {argv[0]!r}: {e}\n")
+            return 2
+    else:
+        if not history:
+            out.write(f"no BENCH_r*.json under {REPO}\n")
+            return 2
+        current = history[-1]
+        history = history[:-1]
+
+    result = gate(current, history)
+    if as_json:
+        out.write(json.dumps(result, indent=1, default=str) + "\n")
+    else:
+        for note in result["notes"]:
+            out.write(f"note: {note}\n")
+        if result["ref"]:
+            out.write(f"gating against {result['ref']} "
+                      f"({len(result['checks'])} checks)\n")
+        for f in result["failures"]:
+            out.write(f"REGRESSION {f}\n")
+        out.write("bench gate: "
+                  + ("PASS\n" if result["passed"] else "FAIL\n"))
+    return 0 if result["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(gate_main())

@@ -10,56 +10,24 @@ Writes the raw trace under _scratch/trace_<step>/ and prints the top device
 ops by total duration (parsed from the perfetto .trace.json.gz), mapped back
 to HLO metadata where present. This is the committed form of the scratch
 script behind PROFILE.md's round-2 findings.
+
+The trace summarizer itself moved to
+flake16_framework_tpu/obs/trace.py (summarize_device_trace) when the
+attribution layer landed; ``summarize`` here is a back-compat alias, the
+same shim pattern as tools/check_telemetry_schema.py.
 """
 
-import glob
-import gzip
 import json
 import os
 import sys
-from collections import defaultdict
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tools"))
 
-
-def summarize(trace_dir, top=25):
-    """Sum device-track slice durations by op name from the newest perfetto
-    trace under ``trace_dir``."""
-    paths = sorted(glob.glob(
-        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True,
-    ), key=os.path.getmtime)
-    if not paths:
-        print(f"no trace found under {trace_dir}")
-        return
-    with gzip.open(paths[-1], "rt") as fd:
-        data = json.load(fd)
-    events = data.get("traceEvents", [])
-    # device tracks: process names containing "TPU" / "Device"
-    pid_name = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            pid_name[e["pid"]] = e["args"].get("name", "")
-    dur_by_name = defaultdict(float)
-    count_by_name = defaultdict(int)
-    total = 0.0
-    for e in events:
-        if e.get("ph") != "X":
-            continue
-        pname = pid_name.get(e.get("pid"), "")
-        if not ("TPU" in pname or "Device" in pname or "/device" in pname):
-            continue
-        d = float(e.get("dur", 0.0))
-        name = e.get("name", "?")
-        dur_by_name[name] += d
-        count_by_name[name] += 1
-        total += d
-    print(f"trace: {paths[-1]}")
-    print(f"device total: {total / 1e6:.3f} s over "
-          f"{sum(count_by_name.values())} slices")
-    for name, d in sorted(dur_by_name.items(), key=lambda kv: -kv[1])[:top]:
-        print(f"{d / 1e6:9.3f} s  x{count_by_name[name]:<5d} {name[:100]}")
+from flake16_framework_tpu.obs.trace import (  # noqa: E402,F401
+    summarize_device_trace as summarize,
+)
 
 
 def trace_fit():
