@@ -53,6 +53,16 @@ FB_N_TREES = int(os.environ.get("BENCH_FB_N_TREES", "25"))
 # SHAP stage: explain the first SHAP_EXPLAIN samples on BOTH sides (the
 # full-N numpy baseline alone would take ~5 minutes at N=2000).
 SHAP_EXPLAIN = int(os.environ.get("BENCH_SHAP_EXPLAIN", "512"))
+# Serving bench (bench.py --serve): sustained throughput of the always-on
+# scoring service (serve/) — closed-loop clients scoring through the
+# microbatched queue against AOT-warmed executables. Sized to finish in
+# ~1 min on the CPU backend; the TPU arm rides the watcher chain.
+SERVE_N_TESTS = int(os.environ.get("BENCH_SERVE_N", "512"))
+SERVE_N_TREES = int(os.environ.get("BENCH_SERVE_TREES", "16"))
+SERVE_REQUESTS = int(os.environ.get("BENCH_SERVE_REQUESTS", "256"))
+SERVE_ROWS = int(os.environ.get("BENCH_SERVE_ROWS", "16"))
+SERVE_CLIENTS = int(os.environ.get("BENCH_SERVE_CLIENTS", "8"))
+SERVE_MAX_DEPTH = int(os.environ.get("BENCH_SERVE_MAX_DEPTH", "12"))
 # Max trees grown / explained per device dispatch. The TPU tunnel faults on
 # multi-minute single dispatches (PROFILE.md "device-fault envelope"), so the
 # worker splits ensemble fits and SHAP explains into bounded slices
@@ -826,8 +836,68 @@ def main():
     }))
 
 
+def serve_bench():
+    """bench.py --serve: sustained-throughput measurement of the scoring
+    service. Fits + registers the study's two SHAP configs (trees scaled
+    by BENCH_SERVE_TREES), warms every (model, kind, bucket) executable,
+    then drives BENCH_SERVE_REQUESTS predict requests through
+    BENCH_SERVE_CLIENTS closed-loop clients. Prints ONE JSON line whose
+    detail carries the two gated metrics: serve_rps (higher-better) and
+    serve_p99_ms (lower-better, the latency SLO)."""
+    import jax
+
+    configure_jax_cache()
+
+    from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.serve.cli import sustained_load
+    from flake16_framework_tpu.serve.registry import ModelRegistry
+    from flake16_framework_tpu.serve.service import ScoringService
+
+    feats, labels, projects, names, pids = make_data(SERVE_N_TESTS)
+    registry = ModelRegistry("serve-registry")
+    overrides = {"Extra Trees": SERVE_N_TREES,
+                 "Random Forest": SERVE_N_TREES}
+    t0 = time.time()
+    for keys in cfg.SHAP_CONFIGS:
+        registry.fit_and_register(keys, feats, labels,
+                                  max_depth=SERVE_MAX_DEPTH,
+                                  tree_overrides=overrides, persist=False)
+    t_fit = time.time() - t0
+
+    t0 = time.time()
+    with ScoringService(registry) as svc:
+        t_warm = time.time() - t0
+        result = sustained_load(
+            svc, feats, registry.ids(), n_requests=SERVE_REQUESTS,
+            rows=SERVE_ROWS, kinds=("predict",), clients=SERVE_CLIENTS)
+
+    print(json.dumps({
+        "metric": "serve_sustained_rps",
+        "value": result["rps"],
+        "unit": "req_per_s",
+        "vs_baseline": None,
+        "detail": {
+            "serve_rps": result["rps"],
+            "serve_p99_ms": result["p99_ms"],
+            "serve_p50_ms": result["p50_ms"],
+            "requests": result["requests"],
+            "rows": SERVE_ROWS,
+            "clients": SERVE_CLIENTS,
+            "n_errors": result["n_errors"],
+            "quarantined": result["quarantined"],
+            "fit_s": round(t_fit, 2),
+            "warm_s": round(t_warm, 2),
+            "n_tests": SERVE_N_TESTS,
+            "n_trees": SERVE_N_TREES,
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(int(sys.argv[2]), int(sys.argv[3]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--serve":
+        serve_bench()
     else:
         main()

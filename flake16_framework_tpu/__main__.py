@@ -24,6 +24,10 @@ plus extension verbs the reference lacks:
     python -m flake16_framework_tpu bench --gate [RESULT.json]
         # regression gate over the committed BENCH_r*.json trajectory
         # (tools/bench_gate.py); exit 1 naming the regressed metric
+    python -m flake16_framework_tpu serve [--ledger scores.pkl] [--json]
+        # always-on scoring service (serve/): AOT-warmed predict+SHAP
+        # executables, microbatched async queue, model registry; drives
+        # a closed-loop client load and prints throughput + p50/p99
 
 Fault tolerance (resilience/): ``scores`` dispatches every config through
 the resilience guard — transient device faults retry with backoff, OOMs
@@ -119,6 +123,12 @@ def main(argv=None):
         from bench_gate import gate_main
 
         code = gate_main(args[1:])
+        if code:
+            raise SystemExit(code)
+    elif command == "serve":
+        from flake16_framework_tpu.serve.cli import serve_main
+
+        code = serve_main(args)
         if code:
             raise SystemExit(code)
     elif command == "lint":

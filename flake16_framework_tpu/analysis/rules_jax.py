@@ -14,7 +14,12 @@ not compute, dominate per-config cost):
 - dtype drift (J301): an explicit float64 under disabled x64 silently
   downcasts — parity bugs that surface as F1 noise, not errors;
 - leftover instrumentation (J401, J402): ``jax.debug.print`` and
-  per-iteration ``block_until_ready`` serialize the dispatch pipeline.
+  per-iteration ``block_until_ready`` serialize the dispatch pipeline;
+- serving hot-path hygiene (J601, ISSUE 6): blocking device->host
+  transfers in the scoring service's request path (serve/batcher.py and
+  serve/queue.py by location, plus any function decorated with
+  ``serve.hot_path``) stall the microbatch pipeline — the one sanctioned
+  crossing per microbatch carries an inline ``f16lint: disable=J601``.
 
 Reachability is a module-local static approximation: a function is
 *jit-reachable* when it is decorated with ``jax.jit`` (bare or via
@@ -66,6 +71,10 @@ RULES = {r.id: r for r in (
              "broad except around a device dispatch without routing the"
              " failure through the resilience layer — faults vanish"
              " unclassified instead of retrying/degrading/quarantining"),
+    RuleInfo("J601", WARNING,
+             "blocking device->host transfer in serve hot-path scope —"
+             " stalls the microbatch pipeline; transfers belong at the"
+             " batch boundary (one amortized crossing per microbatch)"),
 )}
 
 # Call roots whose results are traced arrays (after alias resolution).
@@ -94,6 +103,14 @@ _DISPATCH_MARKERS = {"jax.block_until_ready", "jax.device_get"}
 _RESILIENCE_ROOT = "flake16_framework_tpu.resilience"
 _BROAD_EXCEPTS = {"Exception", "BaseException", "builtins.Exception",
                   "builtins.BaseException"}
+
+# J601: calls that force a device->host transfer (or a full pipeline
+# drain) when they land in serve hot-path scope. Bare
+# ``.block_until_ready()`` attribute calls count too.
+_HOT_BLOCKING = {"jax.block_until_ready", "jax.device_get",
+                 "numpy.asarray", "numpy.array"}
+# Modules that are hot-path scope by location (repo-relative posix).
+_HOT_MODULES = ("batcher.py", "queue.py")
 
 
 def _import_aliases(tree):
@@ -337,6 +354,40 @@ def check_module(mod):
                      "except Exception around a device dispatch must route"
                      " the failure through flake16_framework_tpu.resilience"
                      " (classify / guard / ladder), not swallow it")
+
+    # -- J601: blocking transfers in serve hot-path scope ---------------
+    hot_module = ("serve/" in mod.path
+                  and mod.path.rsplit("/", 1)[-1] in _HOT_MODULES)
+
+    def hot_decorated(fn):
+        for dec in fn.decorator_list:
+            d = _dotted(dec, aliases)
+            if d == "hot_path" or (d or "").endswith(".hot_path"):
+                return True
+        return False
+
+    def scan_hot(root, where):
+        for node in ast.walk(root):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func, aliases)
+            if d in _HOT_BLOCKING:
+                emit("J601", node,
+                     f"{d} in serve hot path ({where}) — blocking "
+                     "device->host transfer; move it to the batch "
+                     "boundary")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "block_until_ready":
+                emit("J601", node,
+                     f".block_until_ready() in serve hot path ({where})")
+
+    if hot_module:
+        scan_hot(mod.tree, f"hot module {mod.path.rsplit('/', 1)[-1]}")
+    else:
+        for fnode in ast.walk(mod.tree):
+            if isinstance(fnode, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and hot_decorated(fnode):
+                scan_hot(fnode, f"@hot_path function {fnode.name!r}")
 
     # -- jit-reachable-only rules --------------------------------------
     for fn in reach.reachable:

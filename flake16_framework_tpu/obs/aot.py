@@ -1,0 +1,209 @@
+"""The AOT executable cache — one signature-keyed store of ahead-of-time
+compiled executables per jitted callable, shared by cost attribution
+(obs/costs.instrument) and the serving layer (serve/store.py).
+
+Extracted from obs/costs.py (ISSUE 6): the serving layer needs exactly
+the machinery the cost instrument already had — ``jfn.lower(...)`` then
+``.compile()``, keyed by (static kwargs, input tree structure, per-leaf
+shape/dtype), called WITHOUT the static kwargs — but without the
+telemetry gate, because a scoring service must hit its pre-compiled
+executables whether or not F16_TELEMETRY is set.
+
+Key invariants (unchanged from the costs.py original):
+
+- The signature key disambiguates calls whose leaf lists coincide but
+  whose tree structures differ; tracer leaves (the wrapped fn inlined
+  into an enclosing jit trace) bypass the AOT path entirely.
+- The AOT executable is called WITHOUT the static kwargs (they are baked
+  in; passing them again breaks the input pytree match). A call that
+  still fails (sharding/donation mismatch this wrapper cannot see) marks
+  the signature bad and falls back to ``jfn`` permanently — the cache
+  can degrade but never break a sweep or a service.
+- Compiles emit a ``cost`` event (flops, bytes, compile wall, persistent
+  compilation-cache traffic) attributed to the cache's span name; the
+  event is a no-op when telemetry is off.
+- Unknown attributes delegate to ``jfn`` (``.lower`` keeps working for
+  tools/hw_trace.py's hand-rolled AOT probes).
+
+The module-level monitoring listener counts jax's
+``/jax/compilation_cache/cache_hits|cache_misses`` events; per-compile
+deltas ride on each ``cost`` event and ``cache_stats()`` feeds the
+run-manifest aggregate (obs/core: heartbeat flush + shutdown).
+
+This module imports jax and therefore must only be imported from modules
+that already do (ops/, parallel/, pipeline.py, serve/) — never from
+obs/core.py or bench.py, which must work without a backend.
+"""
+
+import threading
+import time
+
+import jax
+
+from flake16_framework_tpu.obs import core
+
+_CACHE_EVENTS = {"hits": 0, "misses": 0}
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def _cache_listener(event, *args, **kw):
+    if event == _HIT_EVENT:
+        _CACHE_EVENTS["hits"] += 1
+    elif event == _MISS_EVENT:
+        _CACHE_EVENTS["misses"] += 1
+
+
+def _register_listener():
+    # jax._src.monitoring is the only surface for these events in this
+    # jax; guard the whole hookup so a relocation degrades to zero counts
+    # rather than an import error at sweep start.
+    try:
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(_cache_listener)
+        return True
+    except Exception:
+        return False
+
+
+_LISTENER_OK = _register_listener()
+
+
+def cache_stats():
+    """Aggregate persistent-compilation-cache hits/misses observed by this
+    process (both jit and AOT compiles emit them)."""
+    return dict(_CACHE_EVENTS)
+
+
+def _cost_totals(compiled):
+    """(flops, bytes accessed) from ``compiled.cost_analysis()`` — which
+    returns a list of per-program dicts on this jax version, a plain dict
+    on others, or costs the model declines to report (0.0 then: the
+    ``cost`` event's required fields must always be present)."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return 0.0, 0.0
+    if isinstance(cost, dict):
+        cost = [cost]
+    flops = bytes_ = 0.0
+    for entry in cost or ():
+        if isinstance(entry, dict):
+            flops += float(entry.get("flops", 0.0) or 0.0)
+            bytes_ += float(entry.get("bytes accessed", 0.0) or 0.0)
+    return flops, bytes_
+
+
+class AotExecutableCache:
+    """Signature-keyed AOT executable store around one jitted callable.
+
+    ``gate_on_telemetry=True`` (the cost-instrument contract) makes
+    ``__call__`` a plain passthrough while telemetry is off, preserving
+    obs' zero-overhead-when-disabled invariant for instrumented sweep
+    kernels. The serving layer constructs with ``gate_on_telemetry=False``
+    so its pre-compiled executables serve requests regardless."""
+
+    def __init__(self, jfn, name, static_argnames=(),
+                 gate_on_telemetry=True):
+        self._jfn = jfn
+        self._name = name
+        self._static = frozenset(static_argnames)
+        self._gate = gate_on_telemetry
+        self._cache = {}  # signature -> compiled executable | None (bad)
+        self._lock = threading.Lock()
+
+    def __getattr__(self, attr):
+        return getattr(self._jfn, attr)
+
+    def signature(self, args, kwargs):
+        """Hashable dispatch key — (static kwargs repr, input tree
+        structure, per-leaf shape/dtype) — or None when this call must
+        bypass the AOT path (tracer leaves, or a leaf we cannot key
+        soundly). Deterministic across processes for the same shapes and
+        statics: the registry round-trip contract (serve/registry.py)."""
+        dyn_kwargs = {k: v for k, v in kwargs.items()
+                      if k not in self._static}
+        parts = [tuple(sorted((k, repr(v)) for k, v in kwargs.items()
+                              if k in self._static))]
+        # The treedef disambiguates calls whose leaf lists coincide but
+        # whose structures differ (e.g. edges=None vs tree_keys=None).
+        try:
+            parts.append(jax.tree_util.tree_structure((args, dyn_kwargs)))
+        except Exception:
+            return None
+        for leaf in jax.tree_util.tree_leaves((args, dyn_kwargs)):
+            if isinstance(leaf, jax.core.Tracer):
+                return None
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is not None and dtype is not None:
+                parts.append((tuple(shape), str(dtype)))
+            elif isinstance(leaf, (bool, int, float, complex)):
+                # Weak-typed python scalars: keyed by type, like jit.
+                parts.append(type(leaf).__name__)
+            else:
+                return None
+        return tuple(parts)
+
+    def _compile(self, args, kwargs):
+        t0 = time.perf_counter()
+        lowered = self._jfn.lower(*args, **kwargs)
+        t1 = time.perf_counter()
+        hits0, misses0 = _CACHE_EVENTS["hits"], _CACHE_EVENTS["misses"]
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        flops, bytes_ = _cost_totals(compiled)
+        core.event(
+            "cost", span=self._name, flops=flops, bytes=bytes_,
+            compile_s=round(t2 - t1, 6), lower_s=round(t1 - t0, 6),
+            cache_hits=_CACHE_EVENTS["hits"] - hits0,
+            cache_misses=_CACHE_EVENTS["misses"] - misses0,
+        )
+        return compiled
+
+    def warm(self, *args, **kwargs):
+        """Pre-compile the executable for this argument signature (service
+        start: every registered (model, batch shape) pays its compile
+        before the first request, not during it). Returns the signature
+        key, or None when the arguments cannot be keyed. Compile errors
+        propagate — a service must not start with an uncompilable model."""
+        sig = self.signature(args, kwargs)
+        if sig is None:
+            return None
+        with self._lock:
+            have = self._cache.get(sig) is not None
+        if not have:
+            compiled = self._compile(args, kwargs)
+            with self._lock:
+                self._cache[sig] = compiled
+        return sig
+
+    def __call__(self, *args, **kwargs):
+        if self._gate and core._state is None:
+            return self._jfn(*args, **kwargs)
+        sig = self.signature(args, kwargs)
+        if sig is None:
+            return self._jfn(*args, **kwargs)
+        with self._lock:
+            have = sig in self._cache
+            compiled = self._cache.get(sig)
+        if not have:
+            try:
+                compiled = self._compile(args, kwargs)
+            except Exception:
+                compiled = None  # cost model unavailable for this sig
+            with self._lock:
+                self._cache[sig] = compiled
+        if compiled is None:
+            return self._jfn(*args, **kwargs)
+        dyn_kwargs = {k: v for k, v in kwargs.items()
+                      if k not in self._static}
+        try:
+            return compiled(*args, **dyn_kwargs)
+        except (TypeError, ValueError):
+            # Input-spec mismatch the signature key missed: permanent
+            # fallback for this signature, never a sweep failure.
+            with self._lock:
+                self._cache[sig] = None
+            return self._jfn(*args, **kwargs)

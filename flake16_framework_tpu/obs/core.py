@@ -163,10 +163,13 @@ def shutdown():
 
 
 def _finalize_manifest():
-    """Merge exit-time facts: where the persistent XLA compilation cache
-    lives and how often this process hit/missed it (the round-3 suite
-    budget leans on that cache — make it visible per run). Reads jax and
-    obs.costs via sys.modules only: telemetry never initializes either."""
+    """Merge cache facts into the manifest: where the persistent XLA
+    compilation cache lives and how often this process hit/missed it (the
+    round-3 suite budget leans on that cache — make it visible per run).
+    Called at shutdown AND on every heartbeat (a killed long-running
+    serving process must not lose its hit/miss aggregates to atexit never
+    firing). Reads jax and obs.aot via sys.modules only: telemetry never
+    initializes either."""
     fields = {}
     cache_dir = None
     jaxmod = sys.modules.get("jax")
@@ -179,10 +182,10 @@ def _finalize_manifest():
         cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
     if cache_dir:
         fields["jax_cache_dir"] = str(cache_dir)
-    costs = sys.modules.get("flake16_framework_tpu.obs.costs")
-    if costs is not None:
+    aot = sys.modules.get("flake16_framework_tpu.obs.aot")
+    if aot is not None:
         try:
-            stats = costs.cache_stats()
+            stats = aot.cache_stats()
             fields["jax_cache_hits"] = int(stats.get("hits", 0))
             fields["jax_cache_misses"] = int(stats.get("misses", 0))
         except Exception:
@@ -431,6 +434,13 @@ def start_heartbeat(interval_s=60.0):
             if dev is not None:
                 ev["device_mem_mb"] = round(dev, 1)
             _emit(state, ev)
+            # Flush manifest aggregates on the same cadence: a killed
+            # long-running process (serving) must not lose its cache
+            # hit/miss facts to atexit never firing.
+            try:
+                _finalize_manifest()
+            except Exception:
+                pass
 
     t = threading.Thread(target=beat, name="f16-telemetry-heartbeat",
                          daemon=True)

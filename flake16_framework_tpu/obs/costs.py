@@ -12,177 +12,31 @@ executable (AOT compiles do not populate the normal jit dispatch cache,
 so re-calling ``jfn`` would compile twice; they DO write the persistent
 compilation cache, so cross-process behavior is unchanged).
 
-Invariants the wrapper keeps:
-
-- Telemetry off (``core._state is None``) or tracer arguments (the
-  wrapped fn is being inlined into an enclosing jit trace, e.g.
-  ``trees.fit_forest_hist`` inside the sweep's fused program): plain
-  passthrough to ``jfn`` — zero AOT machinery on those paths.
-- The AOT executable must be called WITHOUT the static kwargs (they are
-  baked into it; passing them again breaks the input pytree match). If
-  that call still fails — e.g. a sharding/donation mismatch this wrapper
-  cannot see — the signature is marked bad and falls back to ``jfn``
-  permanently, so instrumentation can degrade but never break a sweep.
-- Unknown attributes delegate to ``jfn`` (``.lower`` keeps working for
-  tools/hw_trace.py's hand-rolled AOT probes).
-
-The module-level monitoring listener counts jax's
-``/jax/compilation_cache/cache_hits|cache_misses`` events; per-compile
-deltas ride on each ``cost`` event and ``cache_stats()`` feeds the
-run-manifest aggregate (obs/core.shutdown).
+The executable cache itself moved to obs/aot.py (ISSUE 6): the serving
+layer pre-compiles per-model executables through the SAME store class,
+without the telemetry gate. This module keeps the instrument's contract —
+telemetry off (``core._state is None``) or tracer arguments mean plain
+passthrough with zero AOT machinery — and re-exports the cache machinery
+(``_Instrumented``, ``cache_stats``, ``_CACHE_EVENTS``) for back-compat
+with existing callers and tests.
 
 This module imports jax and therefore must only be imported from modules
 that already do (ops/, parallel/, pipeline.py) — never from obs/core.py
 or bench.py, which must work without a backend.
 """
 
-import threading
-import time
-
-import jax
-
-from flake16_framework_tpu.obs import core
-
-_CACHE_EVENTS = {"hits": 0, "misses": 0}
-_HIT_EVENT = "/jax/compilation_cache/cache_hits"
-_MISS_EVENT = "/jax/compilation_cache/cache_misses"
-
-
-def _cache_listener(event, *args, **kw):
-    if event == _HIT_EVENT:
-        _CACHE_EVENTS["hits"] += 1
-    elif event == _MISS_EVENT:
-        _CACHE_EVENTS["misses"] += 1
-
-
-def _register_listener():
-    # jax._src.monitoring is the only surface for these events in this
-    # jax; guard the whole hookup so a relocation degrades to zero counts
-    # rather than an import error at sweep start.
-    try:
-        from jax._src import monitoring
-
-        monitoring.register_event_listener(_cache_listener)
-        return True
-    except Exception:
-        return False
-
-
-_LISTENER_OK = _register_listener()
-
-
-def cache_stats():
-    """Aggregate persistent-compilation-cache hits/misses observed by this
-    process (both jit and AOT compiles emit them)."""
-    return dict(_CACHE_EVENTS)
-
-
-def _cost_totals(compiled):
-    """(flops, bytes accessed) from ``compiled.cost_analysis()`` — which
-    returns a list of per-program dicts on this jax version, a plain dict
-    on others, or costs the model declines to report (0.0 then: the
-    ``cost`` event's required fields must always be present)."""
-    try:
-        cost = compiled.cost_analysis()
-    except Exception:
-        return 0.0, 0.0
-    if isinstance(cost, dict):
-        cost = [cost]
-    flops = bytes_ = 0.0
-    for entry in cost or ():
-        if isinstance(entry, dict):
-            flops += float(entry.get("flops", 0.0) or 0.0)
-            bytes_ += float(entry.get("bytes accessed", 0.0) or 0.0)
-    return flops, bytes_
-
-
-class _Instrumented:
-    """Cost-attributing wrapper around one jitted callable."""
-
-    def __init__(self, jfn, name, static_argnames=()):
-        self._jfn = jfn
-        self._name = name
-        self._static = frozenset(static_argnames)
-        self._cache = {}  # signature -> compiled executable | None (bad)
-        self._lock = threading.Lock()
-
-    def __getattr__(self, attr):
-        return getattr(self._jfn, attr)
-
-    def _signature(self, args, kwargs):
-        """Hashable dispatch key, or None when this call must bypass the
-        AOT path (tracer leaves, or a leaf we cannot key soundly)."""
-        dyn_kwargs = {k: v for k, v in kwargs.items()
-                      if k not in self._static}
-        parts = [tuple(sorted((k, repr(v)) for k, v in kwargs.items()
-                              if k in self._static))]
-        # The treedef disambiguates calls whose leaf lists coincide but
-        # whose structures differ (e.g. edges=None vs tree_keys=None).
-        try:
-            parts.append(jax.tree_util.tree_structure((args, dyn_kwargs)))
-        except Exception:
-            return None
-        for leaf in jax.tree_util.tree_leaves((args, dyn_kwargs)):
-            if isinstance(leaf, jax.core.Tracer):
-                return None
-            shape = getattr(leaf, "shape", None)
-            dtype = getattr(leaf, "dtype", None)
-            if shape is not None and dtype is not None:
-                parts.append((tuple(shape), str(dtype)))
-            elif isinstance(leaf, (bool, int, float, complex)):
-                # Weak-typed python scalars: keyed by type, like jit.
-                parts.append(type(leaf).__name__)
-            else:
-                return None
-        return tuple(parts)
-
-    def _compile(self, args, kwargs):
-        t0 = time.perf_counter()
-        lowered = self._jfn.lower(*args, **kwargs)
-        t1 = time.perf_counter()
-        hits0, misses0 = _CACHE_EVENTS["hits"], _CACHE_EVENTS["misses"]
-        compiled = lowered.compile()
-        t2 = time.perf_counter()
-        flops, bytes_ = _cost_totals(compiled)
-        core.event(
-            "cost", span=self._name, flops=flops, bytes=bytes_,
-            compile_s=round(t2 - t1, 6), lower_s=round(t1 - t0, 6),
-            cache_hits=_CACHE_EVENTS["hits"] - hits0,
-            cache_misses=_CACHE_EVENTS["misses"] - misses0,
-        )
-        return compiled
-
-    def __call__(self, *args, **kwargs):
-        if core._state is None:
-            return self._jfn(*args, **kwargs)
-        sig = self._signature(args, kwargs)
-        if sig is None:
-            return self._jfn(*args, **kwargs)
-        with self._lock:
-            have = sig in self._cache
-            compiled = self._cache.get(sig)
-        if not have:
-            try:
-                compiled = self._compile(args, kwargs)
-            except Exception:
-                compiled = None  # cost model unavailable for this sig
-            with self._lock:
-                self._cache[sig] = compiled
-        if compiled is None:
-            return self._jfn(*args, **kwargs)
-        dyn_kwargs = {k: v for k, v in kwargs.items()
-                      if k not in self._static}
-        try:
-            return compiled(*args, **dyn_kwargs)
-        except (TypeError, ValueError):
-            # Input-spec mismatch the signature key missed: permanent
-            # fallback for this signature, never a sweep failure.
-            with self._lock:
-                self._cache[sig] = None
-            return self._jfn(*args, **kwargs)
+from flake16_framework_tpu.obs.aot import (  # noqa: F401  (back-compat)
+    _CACHE_EVENTS,
+    _LISTENER_OK,
+    _cache_listener,
+    _cost_totals,
+    AotExecutableCache as _Instrumented,
+    cache_stats,
+)
 
 
 def instrument(jfn, name, static_argnames=()):
     """Wrap a jitted callable so its compiles emit ``cost`` events
     attributed to span ``name``. Transparent when telemetry is off."""
-    return _Instrumented(jfn, name, static_argnames)
+    return _Instrumented(jfn, name, static_argnames,
+                         gate_on_telemetry=True)

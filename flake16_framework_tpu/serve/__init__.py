@@ -1,0 +1,50 @@
+"""serve — the always-on scoring service (ISSUE 6; ROADMAP item 4).
+
+The batch verbs answer "re-run the study"; production traffic is "CI
+finished, score these test runs now". This subsystem turns the trained
+artifacts of a sweep into a latency-serving layer:
+
+- ``registry``  — model registry keyed by trained-config artifact
+  (config code + tree-structure/leaf-shape signature), with the sweep's
+  scores ledger as the artifact source and pickle persistence;
+- ``store``     — AOT executable store: predict and SHAP executables
+  pre-compiled per registered batch shape through the shared
+  ``obs.aot.AotExecutableCache`` (no telemetry gate — a service must hit
+  its compiled programs whether or not F16_TELEMETRY is set);
+- ``queue``     — the async request queue (submit -> future);
+- ``batcher``   — shape-bucketed microbatcher: pads coalesced requests
+  to a registered bucket, dispatches through the resilience guard with
+  bounded in-flight batches, pallas->xla ladder + quarantine as the
+  failover path;
+- ``service``   — ``ScoringService``: the in-process client API plus
+  p50/p99 latency and queue-depth emission through the existing
+  telemetry spans/gauges (``report``/``trace`` work unchanged);
+- ``cli``       — the ``serve`` CLI verb.
+
+``hot_path`` marks request-path functions OUTSIDE serve/batcher.py and
+serve/queue.py (which are hot-path scope by location) for f16lint's J601
+rule: blocking device->host transfers (``block_until_ready``,
+``np.asarray`` on device values, ``device_get``) stall the microbatch
+pipeline and belong at batch boundaries, not per request.
+"""
+
+
+def hot_path(fn):
+    """Mark ``fn`` as serve hot-path code for f16lint's J601 rule (no
+    runtime behavior — a static-analysis anchor, like typing markers)."""
+    fn.__f16_hot_path__ = True
+    return fn
+
+
+from flake16_framework_tpu.serve.queue import (  # noqa: E402,F401
+    RequestQueue, RequestRejected, ScoreRequest, ServeError,
+)
+from flake16_framework_tpu.serve.registry import (  # noqa: E402,F401
+    ModelRegistry, RegisteredModel, artifact_signature, configs_from_ledger,
+    model_id_for,
+)
+from flake16_framework_tpu.serve.store import ExecutableStore  # noqa: E402,F401
+from flake16_framework_tpu.serve.batcher import Microbatcher  # noqa: E402,F401
+from flake16_framework_tpu.serve.service import (  # noqa: E402,F401
+    LatencyStats, ScoringService,
+)

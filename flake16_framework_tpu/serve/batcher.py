@@ -1,0 +1,157 @@
+"""Shape-bucketed microbatcher: the serving layer's dispatch engine.
+
+One collector thread drains the request queue (coalescing FIFO
+same-(model, kind) requests), pads each coalesced batch to the smallest
+registered bucket shape, and hands it to a bounded pool of dispatcher
+threads through a ``maxsize=max_inflight`` handoff queue — the handoff
+blocking IS the backpressure that lets the request queue accumulate and
+the next coalesce grow. Every dispatch goes through the resilience
+guard (retry ladder, fault telemetry); a dispatch the guard abandons
+quarantines the model, and the pallas->xla rung (store.call) plus the
+ladder's cpu-fallback device context are the failover path.
+
+Hot-path discipline (f16lint J601 scope): the ONLY device->host
+transfer in this module is the single ``np.asarray`` on a completed
+microbatch result — one crossing amortized over the batch's requests.
+Everything else stays on host-side numpy or device values.
+"""
+
+import queue as _stdqueue
+import threading
+import time
+
+import numpy as np
+
+from flake16_framework_tpu import obs
+from flake16_framework_tpu.resilience import guard as _guard
+from flake16_framework_tpu.resilience import ladder as _ladder
+from flake16_framework_tpu.serve.queue import ServeError
+
+
+class Microbatcher:
+    """Collector + bounded dispatcher pool between a
+    :class:`~flake16_framework_tpu.serve.queue.RequestQueue` and an
+    :class:`~flake16_framework_tpu.serve.store.ExecutableStore`."""
+
+    def __init__(self, store, requests, *, buckets=(8, 32, 128),
+                 max_inflight=2, guard=None, stats=None):
+        self.store = store
+        self.requests = requests
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        self.max_rows = self.buckets[-1]
+        self.guard = guard if guard is not None else _guard.default_guard()
+        self.stats = stats
+        self.quarantined = {}
+        self._handoff = _stdqueue.Queue(maxsize=int(max_inflight))
+        self._stop = threading.Event()
+        self._threads = []
+        self._max_inflight = int(max_inflight)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self):
+        self._stop.clear()
+        self._threads = [threading.Thread(
+            target=self._collect, name="serve-collector", daemon=True)]
+        self._threads += [threading.Thread(
+            target=self._dispatch_loop, name=f"serve-dispatch-{i}",
+            daemon=True) for i in range(self._max_inflight)]
+        for t in self._threads:
+            t.start()
+
+    def stop(self, timeout=5.0):
+        """Stop collecting; in-flight and handed-off batches drain."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout)
+        self._threads = []
+
+    # -- threads ---------------------------------------------------------
+
+    def _collect(self):
+        while not self._stop.is_set():
+            batch = self.requests.take_batch(self.max_rows, wait_s=0.05)
+            if batch:
+                self._handoff.put(batch)
+
+    def _dispatch_loop(self):
+        while True:
+            try:
+                batch = self._handoff.get(timeout=0.05)
+            except _stdqueue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                self._run_batch(batch)
+            finally:
+                self._handoff.task_done()
+
+    # -- dispatch --------------------------------------------------------
+
+    def _bucket_for(self, rows):
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        return self.buckets[-1]
+
+    def _run_batch(self, batch):
+        req0 = batch[0]
+        model = self.store.registry.get(req0.model_id)
+        if model is None:
+            exc = ServeError(f"model not registered: {req0.model_id}")
+            for r in batch:
+                r._fail(exc)
+            return
+        if req0.model_id in self.quarantined:
+            exc = ServeError(
+                f"model quarantined: {req0.model_id} "
+                f"[{self.quarantined[req0.model_id]['fault_class']}]")
+            for r in batch:
+                r._fail(exc)
+            return
+
+        rows = sum(r.n for r in batch)
+        bucket = self._bucket_for(rows)
+        xpad = np.zeros((bucket, len(model.cols)), dtype=np.float32)
+        off = 0
+        for r in batch:
+            xpad[off:off + r.n] = r.x
+            off += r.n
+
+        def thunk():
+            with _ladder.device_context():
+                return self.store.call(model, req0.kind, xpad)
+
+        try:
+            with obs.span("serve.dispatch",
+                          key=f"{req0.model_id}/{req0.kind}",
+                          rows=rows, bucket=bucket, coalesced=len(batch)):
+                out = self.guard.call(
+                    thunk, config_index=model.config_index,
+                    label=f"serve:{req0.model_id}:{req0.kind}")
+        except Exception as e:
+            if isinstance(e, _guard.DispatchAbandoned):
+                self.quarantined[req0.model_id] = {
+                    "fault_class": e.fault_class,
+                    "attempts": len(e.attempts),
+                    "kind": req0.kind,
+                }
+            for r in batch:
+                r._fail(e)
+            return
+
+        host = np.asarray(out)  # f16lint: disable=J601
+        t_done = time.perf_counter()
+        off = 0
+        for r in batch:
+            r._complete(host[off:off + r.n].copy())
+            off += r.n
+            if self.stats is not None:
+                self.stats.record((t_done - r.t_submit) * 1000.0)
+        obs.counter_add("serve.requests", len(batch))
+        obs.gauge("serve.queue_depth", self.requests.depth())
+        if self.stats is not None:
+            snap = self.stats.snapshot()
+            obs.gauge("serve.p50_ms", snap["p50_ms"])
+            obs.gauge("serve.p99_ms", snap["p99_ms"])

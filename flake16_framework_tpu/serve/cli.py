@@ -1,0 +1,142 @@
+"""The ``serve`` CLI verb: stand the scoring service up, drive it with a
+closed-loop client load, print one JSON stats line.
+
+    python -m flake16_framework_tpu serve [--synth N] [--trees T]
+        [--max-depth D] [--ledger scores.pkl] [--limit K]
+        [--requests N] [--rows R] [--clients C]
+        [--kinds predict,shap] [--buckets 8,32,128]
+        [--registry DIR] [--json]
+
+Without ``--ledger`` it fits + registers the study's two SHAP configs
+(config.SHAP_CONFIGS) on synthetic data; with it, every config the
+sweep's scores ledger holds (canonical grid order, ``--limit`` bounds
+the count). ``--registry DIR`` persists the artifacts (register ->
+reload round-trips). ``sustained_load`` is the same closed-loop driver
+bench.py --serve measures with — the CLI is the interactive arm of the
+sustained-throughput benchmark.
+"""
+
+import json
+import sys
+import threading
+import time
+
+
+def sustained_load(service, feats, model_ids, *, n_requests=256, rows=16,
+                   kinds=("predict",), clients=8, timeout=120.0):
+    """Closed-loop client load: ``clients`` threads, each scoring its
+    share of ``n_requests`` synchronously (round-robin over models and
+    kinds, sliding row windows over ``feats``). Returns the measured
+    stats dict: requests, wall_s, rps, p50/p99, errors."""
+    n_clients = max(1, min(int(clients), int(n_requests)))
+    per = int(n_requests) // n_clients
+    errors = []
+    lock = threading.Lock()
+
+    def client(ci):
+        for i in range(per):
+            j = ci * per + i
+            model_id = model_ids[j % len(model_ids)]
+            kind = kinds[j % len(kinds)]
+            off = (j * rows) % max(1, feats.shape[0] - rows)
+            try:
+                service.score(model_id, feats[off:off + rows], kind=kind,
+                              timeout=timeout)
+            except Exception as e:
+                with lock:
+                    errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+               for ci in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    total = per * n_clients
+    snap = service.latency.snapshot()
+    svc = service.stats()
+    return {
+        "requests": total,
+        "completed": snap["count"],
+        "clients": n_clients,
+        "rows": rows,
+        "kinds": list(kinds),
+        "wall_s": round(wall, 4),
+        "rps": round(total / wall, 2) if wall > 0 else None,
+        "p50_ms": snap["p50_ms"],
+        "p99_ms": snap["p99_ms"],
+        "queue_depth": svc["queue_depth"],
+        "quarantined": sorted(svc["quarantined"]),
+        "errors": errors[:8],
+        "n_errors": len(errors),
+    }
+
+
+def _parse(args):
+    opts = {
+        "synth": 512, "trees": 16, "max_depth": 12, "ledger": None,
+        "limit": None, "requests": 256, "rows": 16, "clients": 8,
+        "kinds": ("predict",), "buckets": (8, 32, 128),
+        "registry": None, "json": False,
+    }
+    it = iter(args)
+    for a in it:
+        if a == "--json":
+            opts["json"] = True
+        elif a in ("--synth", "--trees", "--max-depth", "--limit",
+                   "--requests", "--rows", "--clients"):
+            opts[a[2:].replace("-", "_")] = int(next(it))
+        elif a == "--ledger":
+            opts["ledger"] = next(it)
+        elif a == "--registry":
+            opts["registry"] = next(it)
+        elif a == "--kinds":
+            opts["kinds"] = tuple(next(it).split(","))
+        elif a == "--buckets":
+            opts["buckets"] = tuple(int(b) for b in next(it).split(","))
+        else:
+            raise ValueError(f"Unrecognized serve option {a!r}")
+    return opts
+
+
+def serve_main(args):
+    opts = _parse(args)
+
+    from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.serve.registry import ModelRegistry
+    from flake16_framework_tpu.serve.service import ScoringService
+    from flake16_framework_tpu.utils import synth
+
+    feats, labels, _ = synth.make_dataset(n_tests=opts["synth"], seed=7)
+
+    persist = opts["registry"] is not None
+    registry = ModelRegistry(opts["registry"] or "serve-registry")
+    overrides = {"Extra Trees": opts["trees"],
+                 "Random Forest": opts["trees"]}
+    if opts["ledger"]:
+        registry.register_from_ledger(
+            opts["ledger"], feats, labels, limit=opts["limit"],
+            max_depth=opts["max_depth"], tree_overrides=overrides,
+            persist=persist)
+    else:
+        for keys in cfg.SHAP_CONFIGS:
+            registry.fit_and_register(
+                keys, feats, labels, max_depth=opts["max_depth"],
+                tree_overrides=overrides, persist=persist)
+
+    with ScoringService(registry, buckets=opts["buckets"]) as svc:
+        result = sustained_load(
+            svc, feats, registry.ids(), n_requests=opts["requests"],
+            rows=opts["rows"], kinds=opts["kinds"],
+            clients=opts["clients"])
+
+    import jax
+
+    result["backend"] = jax.default_backend()
+    result["models"] = registry.ids()
+    print(json.dumps(result) if opts["json"]
+          else json.dumps(result, indent=1))
+    sys.stdout.flush()
+    return 1 if result["n_errors"] else 0

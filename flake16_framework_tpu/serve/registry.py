@@ -1,0 +1,287 @@
+"""Model registry: trained-config artifacts the scoring service serves.
+
+A *registered model* is the trained artifact of one grid config — the
+node-trimmed forest, the preprocessing affine (mu, W), the feature
+columns, and the config's identity (key tuple + canonical 216-order
+index, the same index the fault-injection plan addresses). Registration
+reuses the SHAP stage's fit recipe exactly (pipeline.shap_for_config's
+staged path: preprocess -> transform -> resample -> fit on the balanced
+full set), so a served prediction is the same program the study's
+explain stage ran.
+
+Identity is the **artifact signature**: (config code, pytree structure,
+per-leaf shape/dtype) of the (forest, mu, W) artifact — the same key
+family ``obs.aot.AotExecutableCache.signature`` dispatches on, which is
+what makes the round-trip contract testable: register -> persist ->
+reload must yield an identical executable signature, i.e. the reloaded
+model hits the very executables warmed before the save.
+
+The sweep's scores ledger is the artifact *source*: ``configs_from_
+ledger`` reads a (partial or complete) ``scores.pkl`` and returns its
+config keys in canonical grid order, so "serve what the sweep scored"
+is one call. Persistence is one pickle per model under the registry
+root plus a ``registry.json`` index (atomic replace, like every other
+artifact writer in this repo).
+"""
+
+import hashlib
+import json
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flake16_framework_tpu import config as cfg
+from flake16_framework_tpu.ops import trees
+from flake16_framework_tpu.ops.preprocess import fit_preprocess, transform
+from flake16_framework_tpu.ops.resample import resample
+
+REGISTRY_SCHEMA = "flake16-serve-registry-v1"
+INDEX_FILE = "registry.json"
+
+
+def model_id_for(config_keys):
+    """Stable, filesystem-safe id for a config's artifact slot (the key
+    tuple is unique per grid config, so no hash suffix is needed)."""
+    return "-".join("".join(ch for ch in k.lower() if ch.isalnum())
+                    for k in config_keys)
+
+
+def config_index_for(config_keys):
+    """The config's index in the canonical 216-order
+    (config.iter_config_keys) — the address fault-injection plans and the
+    sweep's per-config RNG both use. None for an off-grid tuple."""
+    for i, keys in enumerate(cfg.iter_config_keys()):
+        if tuple(keys) == tuple(config_keys):
+            return i
+    return None
+
+
+class RegisteredModel:
+    """One trained-config artifact: everything a serve dispatch needs."""
+
+    __slots__ = ("model_id", "config_keys", "config_index", "forest",
+                 "mu", "wmat", "cols", "depth", "seed", "max_depth")
+
+    def __init__(self, *, model_id, config_keys, config_index, forest,
+                 mu, wmat, cols, depth, seed, max_depth):
+        self.model_id = model_id
+        self.config_keys = tuple(config_keys)
+        self.config_index = config_index
+        self.forest = forest
+        self.mu = mu
+        self.wmat = wmat
+        self.cols = tuple(cols)
+        self.depth = int(depth)
+        self.seed = int(seed)
+        self.max_depth = int(max_depth)
+
+
+def artifact_signature(model):
+    """(config code, tree structure, per-leaf shape/dtype) of the served
+    artifact — the registry's identity key. Deterministic across
+    processes for the same trained shapes; the executable-store dispatch
+    key is derived from the same leaves, so equal artifact signatures
+    imply identical executable signatures at every registered batch
+    shape (tests/test_serve.py pins the round trip)."""
+    art = (model.forest, model.mu, model.wmat)
+    leaves = jax.tree_util.tree_leaves(art)
+    return (
+        "/".join(model.config_keys),
+        str(jax.tree_util.tree_structure(art)),
+        tuple((tuple(leaf.shape), str(leaf.dtype)) for leaf in leaves),
+    )
+
+
+def signature_digest(model):
+    return hashlib.sha1(repr(artifact_signature(model)).encode()) \
+        .hexdigest()[:16]
+
+
+def configs_from_ledger(scores_pkl):
+    """Config key tuples present in a sweep scores ledger, in canonical
+    grid order — the artifact source for "serve what the sweep scored"."""
+    with open(scores_pkl, "rb") as fd:
+        ledger = pickle.load(fd)
+    if not isinstance(ledger, dict):
+        raise ValueError(f"{scores_pkl}: not a scores ledger (want a dict)")
+    present = {tuple(k) for k in ledger}
+    return [keys for keys in cfg.iter_config_keys() if keys in present]
+
+
+def fit_model(config_keys, feats, labels_raw, *, max_depth=48,
+              tree_overrides=None, seed=0):
+    """Train one config's artifact — the SHAP stage's fit recipe
+    (pipeline.shap_for_config staged path), then node-trim the forest
+    once so the artifact signature is stable and the SHAP executable's
+    leaf-slot workspace is sized to the grown trees, not the fit-time
+    worst-case bound."""
+    fl, cols, prep, bal, spec = cfg.resolve_config(config_keys)
+    if tree_overrides and spec.name in tree_overrides:
+        spec = type(spec)(spec.name, tree_overrides[spec.name],
+                          spec.bootstrap, spec.random_splits,
+                          spec.sqrt_features)
+
+    x = np.asarray(feats[:, list(cols)], dtype=np.float32)
+    y = np.asarray(labels_raw) == fl
+    n = x.shape[0]
+
+    key = jax.random.PRNGKey(seed)
+    mu, wmat = jax.jit(fit_preprocess)(x, prep)
+    xp = transform(x, mu, wmat)
+    kb, kf = jax.random.split(key)
+    xs, ys, ws = resample(xp, y, np.ones(n, np.float32), bal, kb, 2 * n)
+    fit_kw = dict(
+        n_trees=spec.n_trees, bootstrap=spec.bootstrap,
+        random_splits=spec.random_splits, sqrt_features=spec.sqrt_features,
+        max_depth=max_depth, max_nodes=4 * n,
+    )
+    forest = (trees.fit_forest_hist if spec.n_trees > 1
+              else trees.fit_forest)(xs, ys, ws, kf, **fit_kw)
+
+    # One registration-time host sync (cold path, never per request):
+    # trim to the grown node count rounded to 128 slots, exactly like
+    # treeshap.forest_shap_class0's top-level trim.
+    m = forest.feature.shape[-1]
+    n_used = int(jax.device_get(jnp.max(forest.n_nodes)))
+    m_trim = min(m, max(128, -(-n_used // 128) * 128))
+    if m_trim < m:
+        forest = trees.trim_nodes(forest, m_trim)
+
+    return RegisteredModel(
+        model_id=model_id_for(config_keys), config_keys=config_keys,
+        config_index=config_index_for(config_keys), forest=forest,
+        mu=mu, wmat=wmat, cols=cols, depth=int(forest.max_depth),
+        seed=seed, max_depth=max_depth,
+    )
+
+
+class ModelRegistry:
+    """The registry: in-memory map + on-disk artifact store under
+    ``root``. All writes are atomic replaces; ``load()`` rebuilds the
+    map from disk (service restart)."""
+
+    def __init__(self, root):
+        self.root = root
+        self._models = {}
+
+    # -- access ----------------------------------------------------------
+
+    def get(self, model_id):
+        return self._models.get(model_id)
+
+    def ids(self):
+        return sorted(self._models)
+
+    def models(self):
+        return [self._models[m] for m in self.ids()]
+
+    def __len__(self):
+        return len(self._models)
+
+    def __contains__(self, model_id):
+        return model_id in self._models
+
+    # -- registration ----------------------------------------------------
+
+    def register(self, model, persist=True):
+        self._models[model.model_id] = model
+        if persist:
+            self._persist(model)
+        return model
+
+    def fit_and_register(self, config_keys, feats, labels_raw, *,
+                         max_depth=48, tree_overrides=None, seed=0,
+                         persist=True):
+        model = fit_model(config_keys, feats, labels_raw,
+                          max_depth=max_depth,
+                          tree_overrides=tree_overrides, seed=seed)
+        return self.register(model, persist=persist)
+
+    def register_from_ledger(self, scores_pkl, feats, labels_raw, *,
+                             limit=None, **fit_kw):
+        """Fit + register every config the sweep's scores ledger holds
+        (canonical order; ``limit`` bounds the count for bounded service
+        start)."""
+        configs = configs_from_ledger(scores_pkl)
+        if limit is not None:
+            configs = configs[:limit]
+        return [self.fit_and_register(keys, feats, labels_raw, **fit_kw)
+                for keys in configs]
+
+    # -- persistence -----------------------------------------------------
+
+    def _persist(self, model):
+        os.makedirs(self.root, exist_ok=True)
+        path = os.path.join(self.root, f"{model.model_id}.pkl")
+        record = {
+            "schema": REGISTRY_SCHEMA,
+            "config_keys": list(model.config_keys),
+            "config_index": model.config_index,
+            "cols": list(model.cols),
+            "depth": model.depth,
+            "seed": model.seed,
+            "max_depth": model.max_depth,
+            "forest": {f: np.asarray(getattr(model.forest, f))
+                       for f in model.forest._fields},
+            "mu": np.asarray(model.mu),
+            "wmat": np.asarray(model.wmat),
+        }
+        with open(path + ".tmp", "wb") as fd:
+            pickle.dump(record, fd)
+        os.replace(path + ".tmp", path)
+        self._write_index()
+
+    def _write_index(self):
+        index = {
+            "schema": REGISTRY_SCHEMA,
+            "models": {
+                m.model_id: {
+                    "config": "/".join(m.config_keys),
+                    "config_index": m.config_index,
+                    "file": f"{m.model_id}.pkl",
+                    "signature_sha1": signature_digest(m),
+                } for m in self.models()
+            },
+        }
+        path = os.path.join(self.root, INDEX_FILE)
+        with open(path + ".tmp", "w") as fd:
+            json.dump(index, fd, indent=1)
+        os.replace(path + ".tmp", path)
+
+    def load(self):
+        """Rebuild the in-memory map from the on-disk index. Returns the
+        loaded models; unreadable entries are skipped (a torn artifact
+        must not block serving the rest)."""
+        path = os.path.join(self.root, INDEX_FILE)
+        if not os.path.exists(path):
+            return []
+        with open(path) as fd:
+            index = json.load(fd)
+        loaded = []
+        for model_id, entry in sorted(
+                (index.get("models") or {}).items()):
+            try:
+                with open(os.path.join(self.root, entry["file"]),
+                          "rb") as fd:
+                    rec = pickle.load(fd)
+                forest = trees.Forest(
+                    *[jnp.asarray(rec["forest"][f])
+                      for f in trees.Forest._fields])
+                model = RegisteredModel(
+                    model_id=model_id,
+                    config_keys=tuple(rec["config_keys"]),
+                    config_index=rec["config_index"], forest=forest,
+                    mu=jnp.asarray(rec["mu"]),
+                    wmat=jnp.asarray(rec["wmat"]), cols=rec["cols"],
+                    depth=rec["depth"], seed=rec["seed"],
+                    max_depth=rec["max_depth"],
+                )
+            except (OSError, KeyError, ValueError,
+                    pickle.UnpicklingError, EOFError):
+                continue
+            self._models[model_id] = model
+            loaded.append(model)
+        return loaded

@@ -1,0 +1,157 @@
+"""ScoringService: the in-process client API over registry + store +
+queue + microbatcher, with latency SLO telemetry.
+
+``start()`` pre-compiles every registered model's predict and SHAP
+executables at every bucket shape (under the ``serve.warm`` span — the
+compile bill is paid at service start, never during a request) and
+starts the batcher threads. ``submit`` returns the request future;
+``score`` is the synchronous wrapper. p50/p99 latency and queue depth
+flow through the existing telemetry gauges, so ``report`` and ``trace``
+work unchanged on a serving run.
+"""
+
+import threading
+
+import numpy as np
+
+from flake16_framework_tpu import obs
+from flake16_framework_tpu.serve.batcher import Microbatcher
+from flake16_framework_tpu.serve.queue import (
+    RequestQueue, RequestRejected, ScoreRequest,
+)
+from flake16_framework_tpu.serve.store import ExecutableStore, KINDS
+
+
+class LatencyStats:
+    """Thread-safe bounded ring of request latencies (ms) with p50/p99
+    snapshots — the service's SLO instrument."""
+
+    def __init__(self, window=2048):
+        self._window = int(window)
+        self._lock = threading.Lock()
+        self._ring = []
+        self._idx = 0
+        self._count = 0
+
+    def record(self, ms):
+        with self._lock:
+            if len(self._ring) < self._window:
+                self._ring.append(float(ms))
+            else:
+                self._ring[self._idx] = float(ms)
+                self._idx = (self._idx + 1) % self._window
+            self._count += 1
+
+    def snapshot(self):
+        with self._lock:
+            vals = sorted(self._ring)
+            count = self._count
+        if not vals:
+            return {"count": 0, "p50_ms": None, "p99_ms": None}
+
+        def pct(p):
+            return vals[min(len(vals) - 1, round(p * (len(vals) - 1)))]
+
+        return {"count": count, "p50_ms": round(pct(0.50), 3),
+                "p99_ms": round(pct(0.99), 3)}
+
+
+class ScoringService:
+    """The always-on scoring service (in-process form).
+
+    ``with ScoringService(registry) as svc: svc.score(mid, x)`` — or
+    ``start()``/``stop()`` explicitly. Admission raises
+    :class:`RequestRejected` (unknown/quarantined model, bad kind,
+    oversize batch, full queue); a dispatch the resilience guard
+    abandoned re-raises from ``result()`` as DispatchAbandoned.
+    """
+
+    def __init__(self, registry, *, buckets=(8, 32, 128), max_inflight=2,
+                 queue_max=256, guard=None, donate=None):
+        self.registry = registry
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        self.store = ExecutableStore(registry, donate=donate)
+        self.requests = RequestQueue(maxsize=queue_max)
+        self.latency = LatencyStats()
+        self.batcher = Microbatcher(
+            self.store, self.requests, buckets=self.buckets,
+            max_inflight=max_inflight, guard=guard, stats=self.latency)
+        self._started = False
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self):
+        """Warm every (model, kind, bucket) executable, then start the
+        batcher threads. Compile errors on the xla arms propagate — an
+        unservable registry must fail here, not at the first request."""
+        with obs.span("serve.warm", key=f"models={len(self.registry)}"):
+            for model in self.registry.models():
+                self.store.warm(model, self.buckets)
+        obs.manifest_update(
+            verb="serve", serve_models=len(self.registry),
+            serve_buckets=list(self.buckets))
+        self.batcher.start()
+        self._started = True
+        return self
+
+    def stop(self):
+        self.requests.close()
+        self.batcher.stop()
+        self._started = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- client API ------------------------------------------------------
+
+    def _admit(self, model_id, x, kind):
+        if kind not in KINDS:
+            raise RequestRejected(f"unknown kind: {kind!r} (want {KINDS})")
+        model = self.registry.get(model_id)
+        if model is None:
+            raise RequestRejected(f"model not registered: {model_id}")
+        if model_id in self.batcher.quarantined:
+            raise RequestRejected(
+                f"model quarantined: {model_id} "
+                f"[{self.batcher.quarantined[model_id]['fault_class']}]")
+        x = np.asarray(x, dtype=np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        n_cols = len(model.cols)
+        if x.ndim != 2:
+            raise RequestRejected(f"want [n, features], got {x.shape}")
+        if x.shape[1] != n_cols:
+            if x.shape[1] > max(model.cols):
+                x = x[:, list(model.cols)]  # full feature rows: select
+            else:
+                raise RequestRejected(
+                    f"feature width {x.shape[1]} matches neither the "
+                    f"config's {n_cols} columns nor the full set")
+        if not 1 <= x.shape[0] <= self.buckets[-1]:
+            raise RequestRejected(
+                f"batch rows {x.shape[0]} outside [1, {self.buckets[-1]}]"
+                " (split client-side)")
+        return model, x
+
+    def submit(self, model_id, x, kind="predict"):
+        """Admit one request; returns the :class:`ScoreRequest` future."""
+        _, x = self._admit(model_id, x, kind)
+        return self.requests.submit(ScoreRequest(model_id, x, kind=kind))
+
+    def score(self, model_id, x, kind="predict", timeout=None):
+        """Synchronous submit+result."""
+        return self.submit(model_id, x, kind=kind).result(timeout)
+
+    def stats(self):
+        snap = self.latency.snapshot()
+        return {
+            "models": self.registry.ids(),
+            "requests": snap["count"],
+            "p50_ms": snap["p50_ms"],
+            "p99_ms": snap["p99_ms"],
+            "queue_depth": self.requests.depth(),
+            "quarantined": dict(self.batcher.quarantined),
+        }

@@ -1,0 +1,152 @@
+"""AOT executable store: the serving layer's pre-compiled programs.
+
+One :class:`~flake16_framework_tpu.obs.aot.AotExecutableCache` per kind
+(predict / SHAP-xla / SHAP-pallas), **shared across every registered
+model** — the compiled programs take the forest, mu and W as runtime
+arguments, so models with equal artifact shapes dispatch through the
+same executable and the compile bill is paid once per (shape, bucket),
+not once per model. The caches are constructed with
+``gate_on_telemetry=False``: a service must hit its compiled programs
+whether or not F16_TELEMETRY is set.
+
+The preprocessing affine is folded into the device program
+(``transform(x, mu, W)`` before the forest walk), so a request carries
+raw selected-column features and the padded batch crosses to the device
+exactly once. SHAP values are w.r.t. the transformed coordinates — the
+same convention the study's explain stage uses.
+
+Failover wiring: the pallas SHAP arm exists only on TPU; its warm
+failure at service start marks the resilience ladder's pallas rung
+broken (the service degrades to the always-warmed xla arm rather than
+refusing to start), and a call-time pallas fault marks the rung broken
+then re-raises so the dispatch guard's retry lands on xla — the
+pallas->xla degradation ladder as the failover path (ISSUE 6).
+"""
+
+import jax
+
+from flake16_framework_tpu.obs import aot as _aot
+from flake16_framework_tpu.ops import trees
+from flake16_framework_tpu.ops import treeshap
+from flake16_framework_tpu.ops.preprocess import transform
+from flake16_framework_tpu.resilience import ladder
+
+KINDS = ("predict", "shap")
+
+
+def _predict_raw(forest, mu, wmat, x):
+    return trees.predict_proba(forest, transform(x, mu, wmat))
+
+
+def _shap_xla_raw(forest, mu, wmat, x, *, depth):
+    return treeshap._xla_forest_shap(forest, transform(x, mu, wmat),
+                                     depth=depth)
+
+
+def _shap_pallas_raw(forest, mu, wmat, x, *, depth):
+    return treeshap._pallas_forest_shap(forest, transform(x, mu, wmat),
+                                        depth=depth, interpret=False)
+
+
+class ExecutableStore:
+    """Pre-compiled predict + SHAP executables for a registry's models.
+
+    ``donate`` is the donated-argument index tuple for the padded input
+    buffer (position 3 = x). The batcher pads every request batch into a
+    fresh buffer it never reads back, so donation is sound; it defaults
+    off on CPU, where XLA ignores donation with a warning per compile.
+    """
+
+    def __init__(self, registry, *, donate=None):
+        self.registry = registry
+        backend = jax.default_backend()
+        if donate is None:
+            donate = () if backend == "cpu" else (3,)
+        self._predict = _aot.AotExecutableCache(
+            jax.jit(_predict_raw, donate_argnums=donate),
+            "serve.predict", gate_on_telemetry=False)
+        self._shap_xla = _aot.AotExecutableCache(
+            jax.jit(_shap_xla_raw, static_argnames=("depth",),
+                    donate_argnums=donate),
+            "serve.shap_xla", gate_on_telemetry=False)
+        self._shap_pallas = None
+        if backend == "tpu":
+            self._shap_pallas = _aot.AotExecutableCache(
+                jax.jit(_shap_pallas_raw, static_argnames=("depth",),
+                        donate_argnums=donate),
+                "serve.shap_pallas", gate_on_telemetry=False)
+
+    # -- internals -------------------------------------------------------
+
+    def _args(self, model, x):
+        return (model.forest, model.mu, model.wmat, x)
+
+    def _shap_cache(self):
+        """The SHAP arm current ladder state selects: pallas when present
+        and not marked broken, else the always-warmed xla fallback."""
+        if (self._shap_pallas is not None
+                and not ladder.state().pallas_broken):
+            return self._shap_pallas
+        return self._shap_xla
+
+    # -- warm / signatures ----------------------------------------------
+
+    def warm(self, model, bucket_sizes):
+        """Pre-compile every (kind, bucket) executable for one model.
+        Returns {(kind, bucket): signature}. A pallas warm failure marks
+        the ladder's pallas rung broken and the service serves the xla
+        arm — degrade, don't refuse to start. xla compile errors
+        propagate (an unservable model must fail at start, not at the
+        first request)."""
+        import numpy as np
+
+        sigs = {}
+        n_cols = len(model.cols)
+        for bucket in bucket_sizes:
+            x = np.zeros((bucket, n_cols), dtype=np.float32)
+            sigs[("predict", bucket)] = self._predict.warm(
+                *self._args(model, x))
+            sigs[("shap", bucket)] = self._shap_xla.warm(
+                *self._args(model, x), depth=model.depth)
+            if (self._shap_pallas is not None
+                    and not ladder.state().pallas_broken):
+                try:
+                    self._shap_pallas.warm(*self._args(model, x),
+                                           depth=model.depth)
+                except Exception as e:
+                    ladder.mark_pallas_broken(e)
+        return sigs
+
+    def signatures(self, model, bucket):
+        """The dispatch keys one model produces at one bucket, computed
+        WITHOUT compiling — the registry round-trip contract is checked
+        against these (register -> persist -> reload -> identical
+        executable signature)."""
+        import numpy as np
+
+        x = np.zeros((bucket, len(model.cols)), dtype=np.float32)
+        return {
+            "predict": self._predict.signature(self._args(model, x), {}),
+            "shap": self._shap_xla.signature(
+                self._args(model, x), {"depth": model.depth}),
+        }
+
+    # -- dispatch --------------------------------------------------------
+
+    def call(self, model, kind, x):
+        """Dispatch one padded batch through the pre-compiled executable
+        for ``kind``. Called from inside the batcher's guard thunk — a
+        pallas fault marks the rung broken and re-raises so the guard's
+        retry degrades to xla."""
+        if kind == "predict":
+            return self._predict(*self._args(model, x))
+        if kind != "shap":
+            raise ValueError(f"unknown serve kind: {kind!r}")
+        cache = self._shap_cache()
+        if cache is self._shap_pallas:
+            try:
+                return cache(*self._args(model, x), depth=model.depth)
+            except Exception as e:
+                ladder.mark_pallas_broken(e)
+                raise
+        return cache(*self._args(model, x), depth=model.depth)

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from flake16_framework_tpu import obs
+from flake16_framework_tpu.serve import hot_path
 
 
 @jax.jit
@@ -60,6 +61,11 @@ def unguarded_dispatch(x):
         return jax.block_until_ready(jnp.sum(x))
     except Exception:                              # expect J501
         return None
+
+
+@hot_path
+def serve_blocking(y):
+    return jax.block_until_ready(y)                # expect J601
 
 
 def suppressed_examples(xs):

@@ -1,0 +1,361 @@
+"""Scoring-service tests (serve/, ISSUE 6) — all CPU, tiny models.
+
+The two acceptance drills live here: the registry round-trip (register
+-> persist -> reload -> identical executable signature) and the serving
+failover drill (injected fault on a serve dispatch -> guard retries ->
+ladder degrades -> the request still completes, with ``fault`` telemetry
+events on the run). Plus the microbatcher's padding/coalescing
+correctness, admission control, quarantine, the heartbeat manifest
+flush, the bench-gate treatment of the new serve metrics, and the CLI
+smoke.
+"""
+
+import json
+import os
+import pickle
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from flake16_framework_tpu import config as cfg, obs  # noqa: E402
+from flake16_framework_tpu.obs import report as obs_report  # noqa: E402
+from flake16_framework_tpu.ops import trees  # noqa: E402
+from flake16_framework_tpu.ops.preprocess import transform  # noqa: E402
+from flake16_framework_tpu.resilience import (  # noqa: E402
+    faults, guard, inject, ladder,
+)
+from flake16_framework_tpu.serve import (  # noqa: E402
+    ExecutableStore, ModelRegistry, RequestQueue, RequestRejected,
+    ScoreRequest, ScoringService, artifact_signature, model_id_for,
+)
+from flake16_framework_tpu.serve import registry as registry_mod  # noqa: E402
+from flake16_framework_tpu.utils.synth import make_dataset  # noqa: E402
+
+# One tiny tree config (cheapest fit+compile: single tree, no hist path)
+# and one tiny ensemble config (the fused-transform predict/SHAP path at
+# T>1) — both on-grid, so config_index resolves for fault injection.
+DT_CONFIG = ("NOD", "Flake16", "None", "None", "Decision Tree")
+ET_CONFIG = ("NOD", "Flake16", "Scaling", "SMOTE Tomek", "Extra Trees")
+TINY = {"Extra Trees": 4, "Random Forest": 4}
+MAX_DEPTH = 6
+BUCKETS = (4, 16)
+
+
+@pytest.fixture(autouse=True)
+def _ladder_reset():
+    ladder.reset()
+    yield
+    ladder.reset()
+
+
+@pytest.fixture(scope="module")
+def data():
+    feats, labels, _ = make_dataset(n_tests=160, seed=7)
+    return feats, labels
+
+
+@pytest.fixture(scope="module")
+def registry(data, tmp_path_factory):
+    feats, labels = data
+    root = tmp_path_factory.mktemp("serve-registry")
+    reg = ModelRegistry(str(root))
+    for keys in (DT_CONFIG, ET_CONFIG):
+        reg.fit_and_register(keys, feats, labels, max_depth=MAX_DEPTH,
+                             tree_overrides=TINY, seed=3)
+    return reg
+
+
+@pytest.fixture(scope="module")
+def service(registry):
+    svc = ScoringService(registry, buckets=BUCKETS)
+    svc.start()
+    yield svc
+    svc.stop()
+
+
+def _direct(model, x, kind):
+    xp = transform(np.asarray(x[:, list(model.cols)], np.float32),
+                   model.mu, model.wmat)
+    if kind == "predict":
+        return np.asarray(trees.predict_proba(model.forest, xp))
+    from flake16_framework_tpu.ops import treeshap
+
+    return np.asarray(treeshap._xla_forest_shap(
+        model.forest, xp, depth=model.depth))
+
+
+# -- registry ------------------------------------------------------------
+
+
+def test_registry_round_trip(registry):
+    """Acceptance: register -> persist -> reload -> identical executable
+    signature (same artifact signature AND same AOT dispatch keys at a
+    registered batch shape, computed without compiling)."""
+    fresh = ModelRegistry(registry.root)
+    loaded = fresh.load()
+    assert [m.model_id for m in loaded] == registry.ids()
+    store_a, store_b = ExecutableStore(registry), ExecutableStore(fresh)
+    for model_id in registry.ids():
+        a, b = registry.get(model_id), fresh.get(model_id)
+        assert artifact_signature(a) == artifact_signature(b)
+        for bucket in BUCKETS:
+            sa = store_a.signatures(a, bucket)
+            sb = store_b.signatures(b, bucket)
+            assert sa == sb and sa["predict"] is not None \
+                and sa["shap"] is not None
+    index = json.load(open(os.path.join(registry.root, "registry.json")))
+    for model_id, entry in index["models"].items():
+        assert entry["signature_sha1"] == \
+            registry_mod.signature_digest(fresh.get(model_id))
+
+
+def test_model_identity(registry):
+    assert model_id_for(DT_CONFIG) == "nod-flake16-none-none-decisiontree"
+    want = list(cfg.iter_config_keys()).index(DT_CONFIG)
+    assert registry.get(model_id_for(DT_CONFIG)).config_index == want
+    assert registry_mod.config_index_for(("bogus",) * 5) is None
+
+
+def test_configs_from_ledger(tmp_path, registry):
+    ledger = {ET_CONFIG: [0.1] * 4, DT_CONFIG: [0.2] * 4}
+    path = tmp_path / "scores.pkl"
+    path.write_bytes(pickle.dumps(ledger))
+    got = registry_mod.configs_from_ledger(str(path))
+    # canonical 216-order, regardless of dict insertion order
+    assert got == [k for k in cfg.iter_config_keys() if k in ledger]
+    bad = tmp_path / "bad.pkl"
+    bad.write_bytes(pickle.dumps([1, 2]))
+    with pytest.raises(ValueError):
+        registry_mod.configs_from_ledger(str(bad))
+
+
+# -- serving correctness -------------------------------------------------
+
+
+def test_predict_and_shap_match_direct(service, registry, data):
+    feats, _ = data
+    for model_id in registry.ids():
+        model = registry.get(model_id)
+        for kind in ("predict", "shap"):
+            got = service.score(model_id, feats[:3], kind=kind,
+                                timeout=60)
+            np.testing.assert_allclose(
+                got, _direct(model, feats[:3], kind), rtol=1e-5,
+                atol=1e-6)
+
+
+def test_padding_and_coalescing(service, registry, data):
+    """Concurrent 3-row and 5-row requests pad into shared buckets; each
+    caller gets exactly its own rows back."""
+    feats, _ = data
+    model_id = registry.ids()[0]
+    model = registry.get(model_id)
+    reqs = [service.submit(model_id, feats[off:off + n])
+            for off, n in ((0, 3), (3, 5), (8, 4), (12, 1))]
+    outs = [r.result(timeout=60) for r in reqs]
+    for (off, n), out in zip(((0, 3), (3, 5), (8, 4), (12, 1)), outs):
+        assert out.shape[0] == n
+        np.testing.assert_allclose(
+            out, _direct(model, feats[off:off + n], "predict"),
+            rtol=1e-5, atol=1e-6)
+    stats = service.stats()
+    assert stats["requests"] >= 4 and not stats["quarantined"]
+
+
+def test_admission_control(service, registry, data):
+    feats, _ = data
+    with pytest.raises(RequestRejected):
+        service.submit("no-such-model", feats[:2])
+    with pytest.raises(RequestRejected):
+        service.submit(registry.ids()[0], feats[:2], kind="explode")
+    with pytest.raises(RequestRejected):  # rows above the largest bucket
+        service.submit(registry.ids()[0], feats[:BUCKETS[-1] + 1])
+    with pytest.raises(RequestRejected):  # feature width mismatch
+        service.submit(registry.ids()[0], feats[:2, :3])
+
+
+def test_queue_bounds_and_close(data):
+    feats, _ = data
+    q = RequestQueue(maxsize=1)
+    q.submit(ScoreRequest("m", feats[:2]))
+    with pytest.raises(RequestRejected):
+        q.submit(ScoreRequest("m", feats[:2]))
+    assert q.depth() == 1
+    q.close()
+    with pytest.raises(RequestRejected):
+        q.submit(ScoreRequest("m", feats[:2]))
+    # FIFO coalescing only takes same-(model, kind) requests
+    q2 = RequestQueue()
+    q2.submit(ScoreRequest("a", feats[:2]))
+    q2.submit(ScoreRequest("b", feats[:2]))
+    q2.submit(ScoreRequest("a", feats[:2]))
+    batch = q2.take_batch(max_rows=16)
+    assert [r.model_id for r in batch] == ["a", "a"]
+    assert q2.depth() == 1
+
+
+# -- failover drills (acceptance) ----------------------------------------
+
+
+def test_serving_failover_drill(registry, data, tmp_path, monkeypatch):
+    """Acceptance: injected fault on a serve dispatch -> guard retries ->
+    ladder degrades (OOM steps one halving) -> the request completes,
+    and the run's telemetry carries the fault transitions."""
+    feats, _ = data
+    monkeypatch.setenv(inject.ENV_VAR, "*:1:oom")
+    monkeypatch.setenv("F16_FAULT_BACKOFF_S", "0")
+    run_dir = obs.configure(root=str(tmp_path / "telemetry"),
+                            heartbeat_s=0)
+    try:
+        svc = ScoringService(registry, buckets=BUCKETS)
+        svc.start()
+        try:
+            model_id = registry.ids()[0]
+            out = svc.score(model_id, feats[:3], timeout=60)
+            assert out.shape[0] == 3
+            assert not svc.stats()["quarantined"]
+        finally:
+            svc.stop()
+    finally:
+        obs.shutdown()
+    assert ladder.state().halvings >= 1
+    manifest, events = obs_report.load_run(run_dir)
+    rep = obs_report.summarize(manifest, events)
+    fa = rep["faults"]
+    assert fa["by_action"].get("retry", 0) >= 1
+    assert fa["by_action"].get("degrade", 0) >= 1
+    assert fa["by_action"].get("recovered", 0) >= 1
+    assert fa["by_class"].get(faults.OOM, 0) >= 1
+    assert any(e.get("name") == "serve.dispatch" for e in events)
+    assert any(e.get("name") == "serve.warm" for e in events)
+
+
+def test_quarantine_after_abandon(registry, data, monkeypatch):
+    """A model whose dispatch the guard abandons is quarantined: the
+    in-flight request fails with DispatchAbandoned, later submissions are
+    rejected at admission, other models keep serving."""
+    feats, _ = data
+    monkeypatch.setenv(inject.ENV_VAR, "*:*:deterministic")
+    monkeypatch.setenv("F16_FAULT_BACKOFF_S", "0")
+    svc = ScoringService(registry, buckets=BUCKETS)
+    svc.start()
+    try:
+        bad = registry.ids()[0]
+        req = svc.submit(bad, feats[:2])
+        with pytest.raises(guard.DispatchAbandoned):
+            req.result(timeout=60)
+        deadline = time.time() + 10
+        while bad not in svc.stats()["quarantined"] \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        assert bad in svc.stats()["quarantined"]
+        assert svc.stats()["quarantined"][bad]["fault_class"] == \
+            faults.DETERMINISTIC
+        with pytest.raises(RequestRejected):
+            svc.submit(bad, feats[:2])
+    finally:
+        svc.stop()
+
+
+def test_heartbeat_manifest_flush(registry, tmp_path):
+    """Satellite 2: the heartbeat flushes manifest aggregates on its
+    cadence — cache facts are on disk BEFORE shutdown (a killed serving
+    process keeps them)."""
+    run_dir = obs.configure(root=str(tmp_path / "telemetry"),
+                            heartbeat_s=0.05)
+    try:
+        deadline = time.time() + 5
+        manifest = {}
+        while time.time() < deadline:
+            try:
+                with open(os.path.join(run_dir, "manifest.json")) as fd:
+                    manifest = json.load(fd)
+            except (OSError, ValueError):
+                manifest = {}
+            if "jax_cache_hits" in manifest:
+                break
+            time.sleep(0.05)
+        assert "jax_cache_hits" in manifest
+        assert "jax_cache_misses" in manifest
+    finally:
+        obs.shutdown()
+
+
+# -- bench gate: serve metrics -------------------------------------------
+
+
+def _gate():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_gate
+    finally:
+        sys.path.pop(0)
+    return bench_gate
+
+
+def _serve_record(n, rps, p99):
+    return {"n": n, "parsed": {
+        "metric": "serve_sustained_rps", "value": rps,
+        "unit": "req_per_s", "vs_baseline": None,
+        "detail": {"serve_rps": rps, "serve_p99_ms": p99,
+                   "backend": "cpu"}}}
+
+
+def test_gate_serve_metrics_vacuous_then_enforced():
+    bench_gate = _gate()
+    # No comparable predecessor (r01-r05 are speedup records): vacuous.
+    old = {"n": 5, "parsed": {"metric": "e2e_speedup", "value": 30.0,
+                              "unit": "x_vs_single_host_cpu_stack",
+                              "vs_baseline": 30.0, "detail": {}}}
+    res = bench_gate.gate(_serve_record(6, 100.0, 50.0), [old])
+    assert res["passed"] and res["ref"] is None
+    assert any("discontinuity" in n for n in res["notes"])
+    # With a comparable serve round committed, both metrics gate.
+    hist = [old, _serve_record(6, 100.0, 50.0)]
+    good = bench_gate.gate(_serve_record(7, 90.0, 60.0), hist)
+    assert good["passed"]
+    slow_rps = bench_gate.gate(_serve_record(7, 40.0, 50.0), hist)
+    assert not slow_rps["passed"]
+    assert any("serve_rps" in f for f in slow_rps["failures"])
+    slow_p99 = bench_gate.gate(_serve_record(7, 100.0, 200.0), hist)
+    assert not slow_p99["passed"]
+    assert any("serve_p99_ms" in f for f in slow_p99["failures"])
+    # A metric absent from the reference round is a note, not a failure.
+    hist_no_p99 = [old, _serve_record(6, 100.0, None)]
+    res2 = bench_gate.gate(_serve_record(7, 90.0, 60.0), hist_no_p99)
+    assert res2["passed"]
+    assert any("serve_p99_ms" in n and "vacuous" in n
+               for n in res2["notes"])
+
+
+def test_committed_r06_gates_clean():
+    """The committed serve BENCH round must pass the gate against the
+    full committed history (same invariant CI enforces)."""
+    bench_gate = _gate()
+    history = bench_gate.load_history()
+    r06 = [r for r in history if r.get("n") == 6]
+    assert r06, "BENCH_r06.json missing"
+    res = bench_gate.gate(r06[0], [r for r in history
+                                   if r.get("n") != 6])
+    assert res["passed"], res["failures"]
+
+
+# -- CLI smoke -----------------------------------------------------------
+
+
+def test_serve_cli_smoke(capsys):
+    from flake16_framework_tpu.serve.cli import serve_main
+
+    code = serve_main(["--synth", "120", "--trees", "2", "--max-depth",
+                       "4", "--requests", "8", "--rows", "4",
+                       "--clients", "2", "--buckets", "4,8", "--json"])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    stats = json.loads(line)
+    assert code == 0 and stats["n_errors"] == 0
+    assert stats["requests"] == 8 and stats["rps"] > 0
+    assert stats["p99_ms"] is not None
+    assert len(stats["models"]) == 2

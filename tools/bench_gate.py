@@ -27,6 +27,10 @@ regressions in KIND (a 2x wall blowup, a halved speedup), not noise:
 - our walls (``t_ours_scores_s``, ``t_ours_shap_s``) must stay <=
   ``RATIO_CEIL`` x the reference (baseline walls are the CPU stack's
   problem, not ours — not gated);
+- serving SLOs (round 6+, bench.py --serve): ``serve_rps`` gates like a
+  speedup (floor), ``serve_p99_ms`` like a wall (ceiling). A metric
+  absent from the comparable reference round passes vacuously with a
+  note — new metrics must not fail against history that predates them;
 - per-config walls (``per_config_s``) are gated per shared config at
   ``PER_CONFIG_CEIL`` (noisier: single-config timings), tolerating both
   the round-5 dict form ({fit, predict, total}) and older scalars.
@@ -46,8 +50,8 @@ RATIO_FLOOR = 0.65   # higher-is-better metrics: cur >= floor * ref
 RATIO_CEIL = 1.75    # lower-is-better walls:    cur <= ceil * ref
 PER_CONFIG_CEIL = 2.0
 
-HIGHER_BETTER = ("value", "scores_speedup", "shap_speedup")
-LOWER_BETTER = ("t_ours_scores_s", "t_ours_shap_s")
+HIGHER_BETTER = ("value", "scores_speedup", "shap_speedup", "serve_rps")
+LOWER_BETTER = ("t_ours_scores_s", "t_ours_shap_s", "serve_p99_ms")
 
 
 def load_history(repo=REPO):
@@ -127,13 +131,24 @@ def gate(current, history):
 
     for name in HIGHER_BETTER:
         cur, refv = _metric(current, name), _metric(ref, name)
-        if cur is None or refv is None:
+        if cur is None:
+            continue
+        if refv is None:
+            # Metric absent from the comparable reference round (e.g.
+            # serve_rps predates nothing before round 6): vacuously
+            # passing, never a failure against older history.
+            notes.append(f"{name}: absent from reference — "
+                         "vacuous pass (new metric)")
             continue
         limit = RATIO_FLOOR * refv
         check(name, cur, refv, cur >= limit, limit)
     for name in LOWER_BETTER:
         cur, refv = _metric(current, name), _metric(ref, name)
-        if cur is None or refv is None:
+        if cur is None:
+            continue
+        if refv is None:
+            notes.append(f"{name}: absent from reference — "
+                         "vacuous pass (new metric)")
             continue
         limit = RATIO_CEIL * refv
         check(name, cur, refv, cur <= limit, limit)

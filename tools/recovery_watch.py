@@ -280,6 +280,16 @@ def chain():
     persist_bench_json(out, "bench_tpu.json")
     if not stage_ok_to_continue(ok_b, err):
         return False
+    # Serving SLO arm (ISSUE 6): the sustained-throughput bench of the
+    # scoring service on the TPU backend — AOT warms reuse the compile
+    # cache the headline bench just populated, so this is minutes, not
+    # the 70-min headline budget.
+    ok_s, out_s, err = run_stage(
+        "bench_serve", [py, os.path.join(REPO, "bench.py"), "--serve"],
+        1800)
+    persist_bench_json(out_s, "bench_serve_tpu.json")
+    if not stage_ok_to_continue(ok_s, err):
+        return False
     # Exact-tier seeds FIRST, one bounded run per seed with a per-seed
     # cache checkpoint (tools/exact_seed_cache.py): a wedge mid-tier
     # keeps every completed seed, and the next chain attempt only pays
