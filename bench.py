@@ -358,6 +358,24 @@ def worker(n_tests, n_trees):
                 "total": round((res[0] + res[1]) * engine.n_folds, 3),
             }
     t_scores = time.time() - t0
+    # Analytic flop count of the probe's fit stage (trees.fit_stage_flops —
+    # the same model `report --attrib` splits fit sub-stages with). Round 7's
+    # fit_gflops gate metric = this total over the measured fit wall: a
+    # deterministic function of the probe shape, so the gate ratchets fit
+    # THROUGHPUT round-over-round instead of trusting wall-clock alone.
+    from flake16_framework_tpu.ops import trees as _trees
+
+    fit_flops = 0.0
+    for keys in CONFIGS:
+        spec = engine._spec(keys[4])
+        cap = 2 * len(feats)
+        stage_fl = _trees.fit_stage_flops(
+            n=cap, n_feat=len(cfg.FEATURE_SETS[keys[1]]),
+            n_bins=_trees.HIST_BINS,
+            n_trees=spec.n_trees * engine.n_folds,
+            n_nodes=2 * cap, max_nodes=2 * cap,
+        )
+        fit_flops += sum(stage_fl.values())
     # Per-stage record the moment the stage completes: the parent persists
     # it immediately, so a tunnel death during the SHAP stage still leaves
     # the scores measurement on disk (BENCH has been lost to mid-run
@@ -365,6 +383,7 @@ def worker(n_tests, n_trees):
     print(json.dumps({
         "stage": "scores", "t_scores": round(t_scores, 3),
         "t_fit": round(t_fit, 3), "t_predict": round(t_pred, 3),
+        "fit_flops": fit_flops,
         "per_config_s": per_config, "n_tests": n_tests, "n_trees": n_trees,
         "bench_fused": engine.fused, "bench_batch": batch_n,
         "dispatch_trees": DISPATCH_TREES, "backend": jax.default_backend(),
@@ -402,6 +421,7 @@ def worker(n_tests, n_trees):
     print(json.dumps({
         "t_scores": round(t_scores, 3), "t_shap": round(t_shap, 3),
         "t_fit": round(t_fit, 3), "t_predict": round(t_pred, 3),
+        "fit_flops": fit_flops,
         "per_config_s": per_config,
         "per_config_shap_s": per_config_shap,
         "dispatch_trees": DISPATCH_TREES,
@@ -815,6 +835,12 @@ def main():
         t_cpu_shap_s=round(sum(t_base_shap), 2),
         t_ours_scores_s=result["t_scores"], t_ours_shap_s=result["t_shap"],
         t_ours_fit_s=result.get("t_fit"),
+        # Fit throughput in analytic gflops (fit_stage_flops model over the
+        # measured fit wall) — the round-7 ratchet metric (bench_gate.py):
+        # vacuous against rounds that predate it, a floor afterwards.
+        fit_gflops=(round(result["fit_flops"] / result["t_fit"] / 1e9, 3)
+                    if result.get("fit_flops") and result.get("t_fit")
+                    else None),
         t_ours_predict_s=result.get("t_predict"),
         per_config_s=result.get("per_config_s"),
         per_config_shap_s=result.get("per_config_shap_s"),

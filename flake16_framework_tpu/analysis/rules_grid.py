@@ -24,6 +24,14 @@ Checks, each its own rule id:
 - G105 span-collision: a telemetry span name declared in two different
   modules merges unrelated timings in ``report`` — span names must be
   unique per module (the sweep/pipeline naming contract, obs/report.py).
+- G106 knob-census: every ``F16_*`` env read in the package must be
+  declared in the ``KNOBS`` registry below (name + value validator), a
+  declared knob must still be read somewhere (stale entries rot into
+  folklore), and any knob SET in the current environment must hold a
+  value its validator accepts — a typo'd grower A/B arm
+  (``F16_ENSEMBLE_GROWER=hsit``) fails the pre-flight in seconds on the
+  host instead of silently running the wrong tier for hours (the ISSUE-9
+  grower knobs are exactly such model-changing switches).
 
 ``preflight_grid`` is callable with injected axes so tests (and future
 config loaders) can validate a candidate grid without editing config.py.
@@ -47,7 +55,41 @@ RULES = {r.id: r for r in (
     RuleInfo("G104", ERROR, "feature columns out of range or duplicated"),
     RuleInfo("G105", WARNING,
              "telemetry span name declared in more than one module"),
+    RuleInfo("G106", ERROR,
+             "env knob census: undeclared F16_* read, stale registry"
+             " entry, or invalid knob value in the current environment"),
 )}
+
+# The declared F16_* knob registry (G106): name -> (kind, detail).
+# kind "enum": detail is the allowed value tuple; "int"/"float": detail is
+# the inclusive minimum; "str": free-form (censused but not value-checked).
+# Model-CHANGING knobs (grower tier, ET draw, refinement, bins) sit next
+# to pure perf knobs here on purpose: the census is the one place a
+# reviewer sees every behavior switch the package reads.
+KNOBS = {
+    "F16_TELEMETRY": ("str", None),
+    "F16_TELEMETRY_HEARTBEAT_S": ("float", 0.0),
+    "F16_FAULT_INJECT": ("str", None),
+    "F16_FAULT_MAX_ATTEMPTS": ("int", 1),
+    "F16_FAULT_BACKOFF_S": ("float", 0.0),
+    "F16_FAULT_BACKOFF_MAX_S": ("float", 0.0),
+    "F16_FAULT_ENVELOPE_S": ("float", 0.0),
+    "F16_PCA_IMPL": ("enum", ("", "svd", "eigh")),
+    "F16_SHAP_SBLK": ("int", 1),
+    "F16_SHAP_LBLK": ("int", 1),
+    # grower tier + histogram-grower knobs (ops/trees.py, ISSUE 9)
+    "F16_ENSEMBLE_GROWER": ("enum", ("hist", "exact")),
+    "F16_HIST_BINS": ("int", 2),
+    "F16_HIST_NODE_BATCH": ("int", 1),
+    "F16_HIST_NODE_BATCH_CPU": ("int", 0),
+    "F16_HIST_IMPL": ("enum", ("auto", "xla", "einsum", "pallas",
+                               "segsum")),
+    "F16_HIST_REFINE": ("enum", ("exact", "edge")),
+    "F16_ET_DRAW": ("enum", ("value", "rank")),
+    "F16_FEATURE_QUOTA": ("enum", ("sklearn", "informative")),
+    "F16_PREDICT_WINDOW": ("int", 1),
+    "F16_PREDICT_IMPL": ("enum", ("gather", "windows")),
+}
 
 PAPER_GRID_SIZE = 216
 
@@ -188,6 +230,67 @@ def preflight_grid(axes=None, *, n_features=None, expected_size=None,
     return findings
 
 
+def _knob_reads(mod):
+    """(knob, lineno) for every literal ``F16_*`` environment read in a
+    module: ``<env>.get/setdefault/pop("F16_X", ...)`` and
+    ``<env>["F16_X"]`` forms (the resilience policies take an injected
+    ``environ`` mapping, so ANY receiver counts, not just ``os.environ``
+    — a knob string is the census key either way). A name bound to a
+    knob literal (``ENV_VAR = "F16_FAULT_INJECT"``) counts as that
+    knob's read site: the binding exists to be .get()-ed."""
+    out = []
+    for node in ast.walk(mod.tree):
+        const = None
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+                and node.value.value.startswith("F16_")):
+            const = node.value
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("get", "setdefault", "pop")
+                and node.args):
+            const = node.args[0]
+        elif isinstance(node, ast.Subscript):
+            const = node.slice
+        if (isinstance(const, ast.Constant)
+                and isinstance(const.value, str)
+                and const.value.startswith("F16_")):
+            out.append((const.value, node.lineno))
+    return out
+
+
+def _knob_value_ok(kind, detail, raw):
+    if kind == "enum":
+        return raw in detail
+    if kind in ("int", "float"):
+        try:
+            val = int(raw) if kind == "int" else float(raw)
+        except ValueError:
+            return False
+        return val >= detail
+    return True
+
+
+def preflight_knob_values(environ=None):
+    """Validate every SET registry knob's value in ``environ`` (default
+    the real environment) — the grower-knob pre-flight half of G106.
+    Returns Findings; empty means the environment is launchable."""
+    env = os.environ if environ is None else environ
+    findings = []
+    for name, (kind, detail) in sorted(KNOBS.items()):
+        raw = env.get(name)
+        if raw is None or _knob_value_ok(kind, detail, raw):
+            continue
+        want = ("|".join(v for v in detail if v) if kind == "enum"
+                else f"{kind} >= {detail}")
+        findings.append(_finding(
+            "G106", f"env knob {name}={raw!r} is invalid (want {want}) — "
+            "the run would crash at import or silently run a wrong arm",
+            path="flake16_framework_tpu/analysis/rules_grid.py"))
+    return findings
+
+
 def _span_names(mod):
     """(name, lineno) for every literal obs.span("name", ...) in a module."""
     out = []
@@ -203,8 +306,34 @@ def _span_names(mod):
 
 
 def check_project(modules):
-    """Grid pre-flight + cross-module span uniqueness, once per lint run."""
+    """Grid pre-flight + span uniqueness + knob census, once per run."""
     findings = list(preflight_grid())
+    findings.extend(preflight_knob_values())
+
+    reads = {}
+    for mod in modules:
+        if ("/tests/" in f"/{mod.path}" or mod.path.startswith("tests/")
+                or mod.tree is None):
+            continue  # test fixtures may read ad-hoc knobs
+        for name, lineno in _knob_reads(mod):
+            reads.setdefault(name, []).append((mod.path, lineno))
+    for name, sites in sorted(reads.items()):
+        if name not in KNOBS:
+            path, lineno = sites[0]
+            findings.append(Finding(
+                "G106", RULES["G106"].severity, normpath(path), lineno, 0,
+                f"env knob {name!r} is read here but not declared in the "
+                "G106 registry (analysis/rules_grid.py KNOBS) — declare "
+                "it with a validator so the pre-flight can vet its value",
+                snippet=name))
+    # Stale-entry check only when the knob-bearing core is in the linted
+    # set (single-file invocations would otherwise flag every entry).
+    if any(mod.path.endswith("ops/trees.py") for mod in modules):
+        for name in sorted(set(KNOBS) - set(reads)):
+            findings.append(_finding(
+                "G106", f"registry knob {name!r} is declared but never "
+                "read in the package — stale entry (drop it or wire it)",
+                path="flake16_framework_tpu/analysis/rules_grid.py"))
 
     owners = {}
     for mod in modules:

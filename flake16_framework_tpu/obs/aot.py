@@ -105,13 +105,20 @@ class AotExecutableCache:
     so its pre-compiled executables serve requests regardless."""
 
     def __init__(self, jfn, name, static_argnames=(),
-                 gate_on_telemetry=True):
+                 gate_on_telemetry=True, cost_fields=None):
         self._jfn = jfn
         self._name = name
         self._static = frozenset(static_argnames)
         self._gate = gate_on_telemetry
         self._cache = {}  # signature -> compiled executable | None (bad)
         self._lock = threading.Lock()
+        # Optional (args, kwargs) -> dict of extra ``cost``-event fields,
+        # evaluated per compile (ISSUE 9: the tree grower attaches its
+        # analytic per-stage flop split — bin/hist_build/split_scan/
+        # partition — so ``report --attrib`` can split the fit wall without
+        # a profiler session). Must be cheap and shape-only; any failure
+        # degrades to the base event, never breaks the compile.
+        self._cost_fields = cost_fields
 
     def __getattr__(self, attr):
         return getattr(self._jfn, attr)
@@ -154,11 +161,18 @@ class AotExecutableCache:
         compiled = lowered.compile()
         t2 = time.perf_counter()
         flops, bytes_ = _cost_totals(compiled)
+        extra = {}
+        if self._cost_fields is not None:
+            try:
+                extra = dict(self._cost_fields(args, kwargs) or {})
+            except Exception:
+                extra = {}
         core.event(
             "cost", span=self._name, flops=flops, bytes=bytes_,
             compile_s=round(t2 - t1, 6), lower_s=round(t1 - t0, 6),
             cache_hits=_CACHE_EVENTS["hits"] - hits0,
             cache_misses=_CACHE_EVENTS["misses"] - misses0,
+            **extra,
         )
         return compiled
 

@@ -35,8 +35,10 @@ from flake16_framework_tpu.obs.aot import (  # noqa: F401  (back-compat)
 )
 
 
-def instrument(jfn, name, static_argnames=()):
+def instrument(jfn, name, static_argnames=(), cost_fields=None):
     """Wrap a jitted callable so its compiles emit ``cost`` events
-    attributed to span ``name``. Transparent when telemetry is off."""
+    attributed to span ``name``. Transparent when telemetry is off.
+    ``cost_fields``: optional (args, kwargs) -> dict of extra event fields
+    stamped on each compile's ``cost`` event (see AotExecutableCache)."""
     return _Instrumented(jfn, name, static_argnames,
-                         gate_on_telemetry=True)
+                         gate_on_telemetry=True, cost_fields=cost_fields)

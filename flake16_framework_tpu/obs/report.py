@@ -155,10 +155,21 @@ def summarize_attrib(manifest, events):
     split: ``prep_s`` (and shap's ``resample_s``) peel the prep+resample
     dispatch out of the fit wall into a ``resample`` stage, and shap's
     ``fit_s``/``explain_s`` separate growth from the explain itself.
-    ``cost`` events aggregate by their ``span`` name (the kernel)."""
+    ``cost`` events aggregate by their ``span`` name (the kernel).
+
+    The ``fit`` stage is further split into grower sub-stages
+    (``fit.bin`` / ``fit.hist_build`` / ``fit.split_scan`` /
+    ``fit.partition``) when grower cost events carry a ``stage_flops``
+    field (trees.fit_stage_flops, ISSUE 9): each config's fit wall is
+    divided proportionally to the aggregate analytic flop profile — a
+    flops-WEIGHTED attribution, not a measured per-stage wall (stages
+    inside one fused dispatch are not separately timeable), which is
+    exactly enough to name the next fit bottleneck without a profiler
+    session."""
     configs = {}
     stages = {}
     kernels = {}
+    stage_profile = {}  # grower sub-stage -> analytic flops (cost events)
 
     def charge(config, stage, wall):
         if wall <= 0:
@@ -211,6 +222,27 @@ def summarize_attrib(manifest, events):
             for field in ("cache_hits", "cache_misses"):
                 if isinstance(ev.get(field), int):
                     k[field] += ev[field]
+            sf = ev.get("stage_flops")
+            if isinstance(sf, dict):
+                for sname, v in sf.items():
+                    if isinstance(v, (int, float)) and v > 0:
+                        stage_profile[sname] = \
+                            stage_profile.get(sname, 0.0) + float(v)
+
+    # Grower sub-stage refinement (see docstring): divide each fit wall
+    # by the flop profile AFTER the scan — the profile needs every cost
+    # event, and span order is not guaranteed relative to them.
+    prof_total = sum(stage_profile.values())
+    if prof_total > 0:
+        def split_fit(st):
+            wall = st.pop("fit", None)
+            if wall:
+                for sname, v in stage_profile.items():
+                    st[f"fit.{sname}"] = (st.get(f"fit.{sname}", 0.0)
+                                          + wall * v / prof_total)
+        for st in configs.values():
+            split_fit(st)
+        split_fit(stages)
 
     for st in configs.values():
         st["total_s"] = round(sum(st.values()), 4)

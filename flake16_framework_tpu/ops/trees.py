@@ -44,11 +44,13 @@ random order skipping constant features, pure nodes never split.
 
 import functools
 import os
+import sys
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.experimental import pallas as pl
 
 from flake16_framework_tpu.obs import costs as _costs
 from flake16_framework_tpu.resilience import ladder as _res_ladder
@@ -488,19 +490,46 @@ def _fit_one_tree(x, y01, w, key, order0, xsorted, *, random_splits,
 
 
 # --------------------------------------------------------------------------
-# Histogram grower — the MXU formulation.
+# Histogram grower v2 — one batched program per config, three formulations.
 #
 # The exact grower above is sort/gather-bound: profiling on TPU v5e shows
 # >80% of fit time in `searchsorted` lowerings and `take_along_axis` gathers,
-# which TPUs execute serially (~14 ms per [60,16,1000] gather). The ensemble
-# path therefore uses the classic histogram formulation (LightGBM-style),
-# mapped to the MXU: features are quantile-binned ONCE, per-node class
-# histograms are computed as one-hot matmuls
+# which TPUs execute serially (~14 ms per [60,16,1000] gather). The fast
+# tier therefore uses the classic histogram formulation (LightGBM-style):
+# features are quantile-binned ONCE per config, per-node class histograms
+# come from one contraction per step
 #     H[f, node, bin] = sum_n onehot_node[n, node] * w[n] * onehot_bin[n, f, b]
-# (a [W, N] x [N, F*B] contraction — pure MXU work), and split scores come
-# from cumulative sums over the bin axis. No sort, searchsorted, or gather
-# appears in the level loop; the only per-sample "lookup" (routing each
-# sample by its node's chosen feature) is itself a one-hot matmul.
+# and split scores from cumulative sums over the bin axis. Sibling
+# histograms are never rebuilt: the scan over boundaries IS the left-child
+# histogram, and every right-side count is the subtraction  R = total - L
+# (`hist_subtract`; child covers reuse the winning boundary's L the same
+# way) — the one-pass analog of LightGBM's smaller-side trick under static
+# shapes.
+#
+# The histogram+scan step has one resolved implementation (`hist_impl`,
+# canonicalized by ``fit_forest_hist``):
+#
+# - "xla" (CPU default): ONE packed-f32 one-hot matmul. w and w*y are
+#   packed as w + _PACK*wy per sample; the [N,W]x[N,F*B] contraction and
+#   the bin cumsum run once on the packed value, and (cw, cwy) unpack by
+#   floor-divide. Integer-exact while per-node weight sums stay < _PACK
+#   (gated on N; falls back to "einsum" above it). Replaced the round-2
+#   scatter formulation (`segment_sum`, still accepted as the alias
+#   "segsum"): XLA:CPU scatters cost ~36 ns/element serially, and at the
+#   bench shape the packed matmul measures ~10x faster (_scratch/micro_fit:
+#   232 ms -> 12 ms per step-equivalent at N=400 F=16 B=64 W=8 T=250).
+# - "einsum" (TPU fallback rung): the same contraction as a PAIR of bf16
+#   one-hot matmuls (weights are small integers — exact in bf16 operands
+#   with f32 accumulation), then two bin cumsums. Pure MXU work.
+# - "pallas" (TPU default): one kernel fusing the two bf16 dots and the
+#   bin cumsum in VMEM, f-blocked grid (_hist_cumsum_kernel). Bitwise
+#   equal to "einsum" by construction (test-pinned in interpret mode);
+#   `resilience.ladder` degrades pallas -> einsum ("hist" kernel rung) on
+#   the first Mosaic failure, mirroring the Tree SHAP pallas -> xla rung.
+#
+# All three produce identical [F, W, B] cumulative histograms, so scoring,
+# feature choice, routing, and RNG are impl-independent: forests depend
+# only on data + key (impl/backend/width-neutral).
 #
 # Growth is node-batched rather than level-synchronous: BFS allocation makes
 # node ids contiguous in creation order, so the work queue is just a pointer
@@ -508,15 +537,26 @@ def _fit_one_tree(x, y01, w, key, order0, xsorted, *, random_splits,
 # processes the id window [P, P+W). Iteration count is ceil(total_nodes / W)
 # — proportional to tree size, not depth x frontier like the exact grower.
 #
-# Binned thresholds are bin edges (quantile midpoints), not exact sklearn
-# midpoints, so this grower serves the 100-tree ensembles (RF/ET), where
-# split discretization washes out in the ensemble average (parity budget
-# BASELINE.md: F1 +/- 0.01); the single DecisionTree config keeps the exact
-# grower. ExtraTrees randomness: sklearn draws thresholds uniformly over the
-# node's value range; here the draw is uniform in VALUE space over the
-# node's occupied bin span, rounded to bin resolution (F16_ET_DRAW=rank
-# restores the round-2 boundary-index draw — the parity investigation
-# measured it low on the PCA probe config).
+# Parity tier: bin-resolution candidate SELECTION is kept, but the winning
+# threshold is sharpened in-step to the exact sklearn midpoint between the
+# closest member values either side of the chosen bin edge (refine="exact",
+# an O(N x W) masked reduce — no sort). Member (in-bag) routing is
+# provably unchanged (max-left <= edge < min-right over members), so
+# refinement moves only the stored threshold for the grown tree;
+# out-of-bag and held-out rows may legitimately land on the other side of
+# the sharpened threshold — that freedom is what moves held-out F1 toward
+# sklearn (test_hist_refine_exact_moves_only_thresholds pins both
+# properties); with it the SAME grower tier serves the RF/ET ensembles inside
+# the RF-probe parity budget (BASELINE.md F1 +/- 0.01) and carries the
+# bench number. Single-tree DT stays on the exact grower (no ensemble
+# averaging — DT-on-hist diverged -0.066 on the small parity tier), and
+# the exact grower also remains as the ensembles' `grower="exact"`
+# fallback tier.
+# ExtraTrees randomness: sklearn draws thresholds uniformly over the node's
+# value range; here the draw is uniform in VALUE space over the node's
+# occupied bin span, rounded to bin resolution (F16_ET_DRAW=rank restores
+# the round-2 boundary-index draw), and refinement does not apply (sklearn
+# ET thresholds are draws, not midpoints).
 # --------------------------------------------------------------------------
 
 # Histogram-grower tuning knobs. Env-overridable (read at import) so the
@@ -544,12 +584,100 @@ HIST_NODE_BATCH_CPU = int(os.environ.get("F16_HIST_NODE_BATCH_CPU", "0"))
 ET_DRAW = os.environ.get("F16_ET_DRAW", "value")
 if ET_DRAW not in ("value", "rank"):  # a typo'd A/B arm must fail loudly
     raise ValueError(f"F16_ET_DRAW must be value|rank, got {ET_DRAW!r}")
+# Threshold refinement of the winning split (RF/DT; see section comment):
+# "exact" sharpens to sklearn midpoints in-step, "edge" keeps the raw bin
+# edge (the pre-v2 behavior — the parity A/B arm).
+HIST_REFINE = os.environ.get("F16_HIST_REFINE", "exact")
+if HIST_REFINE not in ("exact", "edge"):
+    raise ValueError(
+        f"F16_HIST_REFINE must be exact|edge, got {HIST_REFINE!r}")
+# Histogram implementation override; "auto" resolves per backend + ladder
+# ("segsum" is the accepted alias for what is now the "xla" formulation).
+HIST_IMPL = os.environ.get("F16_HIST_IMPL", "auto")
+if HIST_IMPL not in ("auto", "xla", "einsum", "pallas", "segsum"):
+    raise ValueError(
+        f"F16_HIST_IMPL must be auto|xla|einsum|pallas|segsum, "
+        f"got {HIST_IMPL!r}")
+
+# Packing radix of the "xla" formulation: per-node sums of w and w*y each
+# stay < _PACK, so w + _PACK*wy accumulates both classes in one f32 matmul
+# with every intermediate < _PACK + _PACK^2 < 2^24 (f32-exact).
+_PACK = 2048.0
+
+
+def _canon_hist_impl(impl):
+    return "xla" if impl == "segsum" else impl
+
+
+def hist_tier_default(n_trees=None):
+    """Whether the grower tier selects the histogram grower for a config
+    with ``n_trees`` trees — "hist" unless F16_ENSEMBLE_GROWER=exact (read
+    at call time, matching parallel/sweep.py's per-config read). Shared by
+    the serving/SHAP fit call sites so every layer follows one tier rule.
+    Single-tree DT stays on the exact grower even under the hist tier:
+    without ensemble averaging, bin-granular candidate ranking diverged
+    −0.066 on the small parity tier, and one exact tree is never the fit
+    bottleneck. ``n_trees=None`` means "an ensemble" (env check only)."""
+    if n_trees is not None and n_trees <= 1:
+        return False
+    return os.environ.get("F16_ENSEMBLE_GROWER", "hist") == "hist"
+
+
+def _auto_hist_impl():
+    if jax.default_backend() != "tpu":
+        return "xla"
+    return "einsum" if _res_ladder.pallas_broken("hist") else "pallas"
 
 
 def _cpu_node_batch(max_nodes):
     if HIST_NODE_BATCH_CPU:
         return HIST_NODE_BATCH_CPU
+    # Width swept on the REAL bench configs (prof_fit --engine-only,
+    # F16_HIST_NODE_BATCH_CPU in {4,8,16,32}): 8 wins the total (11.7 s
+    # ensembles vs 14.3/15.2/25.6). bw=4 looks ~14% better on a small
+    # synthetic RF shape but regresses the node-heavy PCA/SMOTE-Tomek ET
+    # config 42% (steps ~ n_nodes / W, and that config grows near the
+    # node cap). Width is a pure perf knob: any value grows the
+    # bit-identical forest.
     return 8 if max_nodes <= 1600 else 16
+
+
+def fit_stage_flops(*, n, n_feat, n_bins, n_trees, n_nodes, max_nodes,
+                    node_batch=None):
+    """Analytic per-stage flop model of one hist-grower fit (host-side,
+    no tracing): {"bin", "hist_build", "split_scan", "partition"} flop
+    counts for ``n_trees`` growths of ``n_nodes``-node trees.
+
+    Three consumers share it (so the attribution story has ONE model):
+    ``report --attrib`` splits the measured fit wall proportionally to
+    these counts (flops-weighted — stages inside one fused dispatch are
+    not separately timeable); bench.py's ``fit_gflops`` gate metric is
+    their total over the fit wall; tools/prof_fit.py prints the same
+    split against its direct kernel walls. Estimates, not op counts —
+    the RELATIVE weights are what attribution needs, so each term keeps
+    only its leading shape factor (the v2 matmul formulation):
+
+    - bin: one-time quantile binning, n x F x B one-hot expansion;
+    - hist_build: per window step, the [n, bw] x [n, F*B] one-hot
+      contraction (2 flops per MAC on the packed operand);
+    - split_scan: per step, cumsum + gini proxy + argmax/extract over
+      the [F, bw, B] histogram space (~12 passes);
+    - partition: per step, the O(n x bw) membership one-hot plus
+      refinement reduces, and O(n) routing gathers.
+    """
+    if node_batch is None:
+        node_batch = (_cpu_node_batch(max_nodes)
+                      if jax.default_backend() == "cpu"
+                      else HIST_NODE_BATCH)
+    bw = max(1, min(node_batch, max_nodes))
+    steps = max(1, -(-int(n_nodes) // bw))
+    per_tree = {
+        "bin": float(n * n_feat * n_bins) / max(1, n_trees),  # shared once
+        "hist_build": float(steps * 2 * n * bw * n_feat * n_bins),
+        "split_scan": float(steps * 12 * n_feat * bw * n_bins),
+        "partition": float(steps * (4 * n * bw + 6 * n)),
+    }
+    return {k: round(v * n_trees, 1) for k, v in per_tree.items()}
 
 
 def quantile_edges(x, n_bins=HIST_BINS):
@@ -564,34 +692,80 @@ def quantile_edges(x, n_bins=HIST_BINS):
     return ((lo + hi) * 0.5).T
 
 
-def _bin_onehot(x, edges):
-    """(onehot [N, F, B] bf16, bin_idx [N, F] i32) for inner ``edges``
-    [F, B-1]; bin index is the count of edges strictly below x."""
+def _bin_onehot(x, edges, dtype=jnp.bfloat16):
+    """(onehot [N, F, B] ``dtype``, bin_idx [N, F] i32) for inner ``edges``
+    [F, B-1]; bin index is the count of edges strictly below x. The xla
+    formulation contracts in f32 (packed weights), the MXU ones in bf16."""
     cmp = x[:, :, None] > edges[None, :, :]
     bin_idx = cmp.sum(-1).astype(jnp.int32)
     n_bins = edges.shape[1] + 1
-    oh = jax.nn.one_hot(bin_idx, n_bins, dtype=jnp.bfloat16)
+    oh = jax.nn.one_hot(bin_idx, n_bins, dtype=dtype)
     return oh, bin_idx
 
 
-def _fit_one_tree_hist(ohfb, bin_idx, edges, y01, w, key, *, random_splits,
-                       max_features, max_depth, max_nodes, hist_impl=None):
+def hist_subtract(total, side):
+    """Sibling histogram by subtraction — counts are small integers, exact
+    in f32, so R = total - L loses nothing. Trivial on purpose: it is the
+    load-bearing identity of the grower (every right-side statistic in the
+    split scan and every right-child cover derives from it; nothing ever
+    rebuilds a sibling histogram from samples), named so the property test
+    (tests/test_trees.py) pins it against a from-scratch rebuild."""
+    return total - side
+
+
+def _hist_cumsum_kernel(ohw_ref, ohwy_ref, ohfb_ref, cw_ref, cwy_ref):
+    """One feature's cumulative class histograms: two bf16 [N,W]x[N,B] dots
+    (contract the sample axis) + the bin cumsum, all in VMEM."""
+    oh = ohfb_ref[0]                                   # [N, B]
+    dn = (((0,), (0,)), ((), ()))
+    hw = lax.dot_general(ohw_ref[...], oh, dn,
+                         preferred_element_type=jnp.float32)
+    hwy = lax.dot_general(ohwy_ref[...], oh, dn,
+                          preferred_element_type=jnp.float32)
+    cw_ref[0] = jnp.cumsum(hw, axis=-1)
+    cwy_ref[0] = jnp.cumsum(hwy, axis=-1)
+
+
+def _pallas_cum_hists(ohw, ohwy, ohfb):
+    """(cw, cwy) [F, W, B] f32 cumulative histograms from bf16 one-hots
+    (ohw/ohwy [N, W], ohfb [N, F, B]); f-blocked grid so each program's
+    working set is one feature's [N, B] one-hot plus the shared [N, W]
+    membership — sized for VMEM at sweep shapes. Interpret mode runs the
+    same ops through XLA off-TPU, which is what pins bitwise equality
+    with the "einsum" formulation."""
+    n, w = ohw.shape
+    _, f, b = ohfb.shape
+    return tuple(pl.pallas_call(
+        _hist_cumsum_kernel,
+        grid=(f,),
+        in_specs=[
+            pl.BlockSpec((n, w), lambda i: (0, 0)),
+            pl.BlockSpec((n, w), lambda i: (0, 0)),
+            pl.BlockSpec((1, n, b), lambda i: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, w, b), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, w, b), lambda i: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((f, w, b), jnp.float32),
+            jax.ShapeDtypeStruct((f, w, b), jnp.float32),
+        ],
+        interpret=jax.default_backend() != "tpu",
+    )(ohw, ohwy, ohfb.transpose(1, 0, 2)))
+
+
+def _fit_one_tree_hist(x, ohfb, bin_idx, edges, y01, w, key, *, random_splits,
+                       max_features, max_depth, max_nodes, node_batch,
+                       hist_impl, refine):
     """Grow one tree from binned features. Returns Forest field arrays
-    (same contract as ``_fit_one_tree``)."""
+    (same contract as ``_fit_one_tree``). ``hist_impl`` arrives resolved
+    and canonical ("xla" | "einsum" | "pallas"); ``node_batch`` is the BFS
+    window width (results-neutral — per-node RNG keys derive from global
+    node ids); ``refine`` ("exact" | "edge") picks whether the winning
+    threshold is sharpened to the exact sklearn midpoint in-step."""
     n, n_feat, n_bins = ohfb.shape
     dt = edges.dtype
-    wdt = jnp.bfloat16  # one-hot/table matmul operands: small integers, exact
-    # Histogram/routing formulation by backend (see step() below). Decided at
-    # trace time; the jit cache is per-backend so each backend traces its
-    # own. ``hist_impl`` ("segsum"/"einsum") overrides — lets a CPU test
-    # assert the two formulations agree bitwise.
-    if hist_impl is None:
-        hist_impl = "segsum" if jax.default_backend() == "cpu" else "einsum"
-    use_segsum = hist_impl == "segsum"
-    node_batch = (_cpu_node_batch(max_nodes)
-                  if jax.default_backend() == "cpu"
-                  else HIST_NODE_BATCH)  # by real backend, NOT hist_impl —
-    # the bitwise segsum/einsum test needs both impls on one node numbering
     bw = min(node_batch, max_nodes)            # node-batch width
     m_pad = max_nodes + 2 * bw
     iota_w = jnp.arange(bw, dtype=jnp.int32)
@@ -604,6 +778,7 @@ def _fit_one_tree_hist(ohfb, bin_idx, edges, y01, w, key, *, random_splits,
     depth = jnp.zeros((m_pad,), jnp.int32)
 
     wy = w * y01
+    pw = w + _PACK * wy                        # packed pair ("xla" impl only)
     sample_node = jnp.where(w > 0, 0, -1).astype(jnp.int32)
     tot_w0, tot_wy0 = jnp.sum(w), jnp.sum(wy)
     value = value.at[0].set(jnp.stack([tot_w0 - tot_wy0, tot_wy0]))
@@ -612,54 +787,50 @@ def _fit_one_tree_hist(ohfb, bin_idx, edges, y01, w, key, *, random_splits,
         (feature, threshold, left, right, value, depth, a, p,
          sample_node) = state
         # Per-NODE keys from global node ids — not from the window start —
-        # so HIST_NODE_BATCH(_CPU) is a pure perf knob: any width grows the
+        # so the node-batch width is a pure perf knob: any width grows the
         # same forest from the same ``key``.
         nkeys = jax.vmap(lambda d: jax.random.fold_in(key, d))(p + iota_w)
         ksplit = jax.vmap(jax.random.split)(nkeys)     # [W, 2, 2]
         kf, kt = ksplit[:, 0], ksplit[:, 1]
 
-        # ---- node membership + class histograms ---------------------------
-        # Two formulations of the same [F, W, B] histograms, chosen by
-        # backend at trace time: one-hot matmuls ride the MXU on TPU, while
-        # CPU executes scatter-adds ~20x faster than the emulated matmul
-        # (measured; keeps the bench's CPU fallback honest-but-usable).
-        # Weights are small integers (fold masks, bootstrap counts), so both
+        # ---- membership + cumulative class histograms ---------------------
+        # Three formulations of the same [F, W, B] cumulative histograms
+        # (section comment above); weights are small integers, all three
         # accumulate exactly in f32 and agree bitwise.
         rel = sample_node - p                          # [N]
         inb = (rel >= 0) & (rel < bw)
-        if use_segsum:
-            onehot = None
-            fi = jnp.arange(n_feat, dtype=jnp.int32)[None, :]
-            flat = ((jnp.clip(rel, 0, bw - 1)[:, None] * n_feat + fi)
-                    * n_bins + bin_idx)                # [N, F]
-            def hist(vec):
-                vals = jnp.broadcast_to(
-                    jnp.where(inb, vec, 0.0)[:, None], (n, n_feat)
-                ).ravel()
-                h = jax.ops.segment_sum(
-                    vals, flat.ravel(), num_segments=bw * n_feat * n_bins
-                )
-                return h.reshape(bw, n_feat, n_bins).transpose(1, 0, 2)
-            hw, hwy = hist(w), hist(wy)
+        onehot = (rel[:, None] == iota_w[None, :]) & inb[:, None]   # [N, W]
+        if hist_impl == "xla":
+            opw = onehot * pw[:, None]                 # [N, W] packed f32
+            c = jnp.cumsum(
+                jnp.einsum("nw,nfb->fwb", opw, ohfb,
+                           preferred_element_type=jnp.float32), axis=-1)
+            cwy = jnp.floor(c * (1.0 / _PACK))
+            cw = c - _PACK * cwy
         else:
-            onehot = ((rel[:, None] == iota_w[None, :]) & inb[:, None])
-            ohw = (onehot * w[:, None]).astype(wdt)    # [N, W]
-            ohwy = (onehot * wy[:, None]).astype(wdt)
-            hw = jnp.einsum("nw,nfb->fwb", ohw, ohfb,
-                            preferred_element_type=jnp.float32)
-            hwy = jnp.einsum("nw,nfb->fwb", ohwy, ohfb,
-                             preferred_element_type=jnp.float32)
+            ohw = (onehot * w[:, None]).astype(jnp.bfloat16)
+            ohwy = (onehot * wy[:, None]).astype(jnp.bfloat16)
+            if hist_impl == "pallas":
+                cw, cwy = _pallas_cum_hists(ohw, ohwy, ohfb)
+            else:                                      # "einsum"
+                cw = jnp.cumsum(
+                    jnp.einsum("nw,nfb->fwb", ohw, ohfb,
+                               preferred_element_type=jnp.float32), axis=-1)
+                cwy = jnp.cumsum(
+                    jnp.einsum("nw,nfb->fwb", ohwy, ohfb,
+                               preferred_element_type=jnp.float32), axis=-1)
 
-        cw = jnp.cumsum(hw, axis=-1)                   # [F, W, B]
-        cwy = jnp.cumsum(hwy, axis=-1)
         tot_w = cw[0, :, -1]                           # [W] (same for all f)
         tot_wy = cwy[0, :, -1]
         lw = cw[..., :-1]                              # boundary b -> [.., b-1]
         lwy = cwy[..., :-1]
-        rw = tot_w[None, :, None] - lw
-        rwy = tot_wy[None, :, None] - lwy
+        # every right-side statistic is histogram SUBTRACTION off the
+        # cumulative left scan — siblings are never rebuilt from samples
+        rw = hist_subtract(tot_w[None, :, None], lw)
+        rwy = hist_subtract(tot_wy[None, :, None], lwy)
         valid = (lw > 0) & (rw > 0)                    # [F, W, B-1]
         nc = jnp.any(valid, axis=-1)                   # [F, W] non-constant
+        edges_w = jnp.broadcast_to(edges[:, None, :], (n_feat, bw, n_bins - 1))
 
         if random_splits:
             # ExtraTrees: sklearn draws the threshold uniformly over the
@@ -673,9 +844,11 @@ def _fit_one_tree_hist(ohfb, bin_idx, edges, y01, w, key, *, random_splits,
             # data motivated the switch: the rank-space draw (uniform over
             # boundary indices, value-width-blind; F16_ET_DRAW=rank
             # restores it) read low on the PCA probe config. All index
-            # arithmetic stays in the tiny [F, W] space via one-hot
-            # reductions — no per-sample gathers.
-            occ = hw > 0
+            # arithmetic stays in the tiny [F, W] space — occupancy comes
+            # from cumsum increases, extraction is take_along_axis there.
+            prev = jnp.concatenate(
+                [jnp.zeros_like(cw[..., :1]), cw[..., :-1]], axis=-1)
+            occ = cw > prev                            # [F, W, B] occupied
             lo = jnp.argmax(occ, axis=-1)              # [F, W]
             hi = n_bins - 1 - jnp.argmax(jnp.flip(occ, -1), axis=-1)
             u = jax.vmap(
@@ -690,43 +863,48 @@ def _fit_one_tree_hist(ohfb, bin_idx, edges, y01, w, key, *, random_splits,
                 first = edges[:, :1] - (edges[:, 1:2] - edges[:, :1])
                 last = edges[:, -1:] + (edges[:, -1:] - edges[:, -2:-1])
                 full = jnp.concatenate([first, edges, last], 1)  # [F, B+1]
-                oh_lo = jax.nn.one_hot(lo, n_bins + 1, dtype=dt)
-                oh_hi = jax.nn.one_hot(hi + 1, n_bins + 1, dtype=dt)
-                vmin = jnp.sum(oh_lo * full[:, None, :], -1)     # [F, W]
-                vmax = jnp.sum(oh_hi * full[:, None, :], -1)
+                fullw = jnp.broadcast_to(full[:, None, :],
+                                         (n_feat, bw, n_bins + 1))
+                vmin = jnp.take_along_axis(fullw, lo[..., None], -1)[..., 0]
+                vmax = jnp.take_along_axis(fullw, (hi + 1)[..., None],
+                                           -1)[..., 0]
                 thr_v = vmin + u * (vmax - vmin)
                 cnt = jnp.sum(edges[:, None, :] < thr_v[:, :, None],
                               axis=-1).astype(jnp.int32)
                 bsel = jnp.clip(cnt, lo + 1, hi)
-            ohb = jax.nn.one_hot(bsel - 1, n_bins - 1, dtype=jnp.float32)
-            lw_j = jnp.sum(lw * ohb, -1)
-            lwy_j = jnp.sum(lwy * ohb, -1)
+            # single-occupied-bin nodes can push bsel out of boundary range;
+            # they are constant (nc False) so the clamp never changes a
+            # selected split — it only keeps the extract indices in-bounds
+            bm1 = jnp.clip(bsel - 1, 0, n_bins - 2)[..., None]
+            lw_j = jnp.take_along_axis(lw, bm1, -1)[..., 0]
+            lwy_j = jnp.take_along_axis(lwy, bm1, -1)[..., 0]
             ok_j = nc & (lw_j > 0) & (tot_w[None, :] - lw_j > 0)
             score_j = _proxy_score(lw_j, lwy_j, tot_w[None, :] - lw_j,
                                    tot_wy[None, :] - lwy_j, ok_j)
             bound_j = bsel
+            thr_j = jnp.take_along_axis(edges_w, bm1, -1)[..., 0]
         else:
             score = _proxy_score(lw, lwy, rw, rwy, valid)   # [F, W, B-1]
             bb = jnp.argmax(score, axis=-1)            # first max = lowest thr
-            score_j = jnp.max(score, axis=-1)
+            bbx = bb[..., None]
+            score_j = jnp.take_along_axis(score, bbx, -1)[..., 0]
             bound_j = bb + 1
-            ohb = jax.nn.one_hot(bb, n_bins - 1, dtype=jnp.float32)
-            lw_j = jnp.sum(lw * ohb, -1)
-            lwy_j = jnp.sum(lwy * ohb, -1)
-        thr_j = jnp.sum(edges[:, None, :] * ohb, -1)   # [F, W]
+            lw_j = jnp.take_along_axis(lw, bbx, -1)[..., 0]
+            lwy_j = jnp.take_along_axis(lwy, bbx, -1)[..., 0]
+            thr_j = jnp.take_along_axis(edges_w, bbx, -1)[..., 0]
 
         # ---- feature choice (sklearn random feature draw) -----------------
         sel = _select_features(nc.transpose(1, 0), kf, max_features)
         score_j = jnp.where(sel.transpose(1, 0), score_j, -jnp.inf)
         best_f = jnp.argmax(score_j, axis=0).astype(jnp.int32)     # [W]
         best_score = jnp.max(score_j, axis=0)
-        ohf = jax.nn.one_hot(best_f, n_feat, dtype=jnp.float32)    # [W, F]
+        bfx = best_f[None, :]
 
         def pick_f(a):                                  # [F, W] -> [W]
-            return jnp.sum(a.transpose(1, 0) * ohf, -1)
+            return jnp.take_along_axis(a, bfx, axis=0)[0]
 
         thr_node = pick_f(thr_j).astype(dt)
-        bound_n = jnp.round(pick_f(bound_j.astype(jnp.float32)))
+        bound_n = pick_f(bound_j).astype(jnp.int32)
         lw_b = pick_f(lw_j)
         lwy_b = pick_f(lwy_j)
 
@@ -743,6 +921,61 @@ def _fit_one_tree_hist(ohfb, bin_idx, edges, y01, w, key, *, random_splits,
         can_split = can_split & (right_g < max_nodes)
         k_splits = jnp.sum(can_split, dtype=jnp.int32)
 
+        # ---- per-sample node parameters (routing + refinement) ------------
+        # Row gathers from tiny [W] tables on the xla impl (cheap on CPU);
+        # a one-hot table matmul on the MXU impls (TPU serializes gathers).
+        # Both yield each in-window sample's (splits?, child rank, bin
+        # bound, winning feature's bin and value).
+        if hist_impl == "xla":
+            rs = jnp.clip(rel, 0, bw - 1)
+            can_mine = inb & can_split[rs]
+            rank_mine = rank[rs]
+            bound_mine = bound_n[rs]
+            bfx_mine = best_f[rs][:, None]
+            xbin_mine = jnp.take_along_axis(bin_idx, bfx_mine, axis=1)[:, 0]
+            xv_mine = jnp.take_along_axis(x, bfx_mine, axis=1)[:, 0]
+        else:
+            # table rows: [can_split, rank, bound] ++ onehot(best_f) — all
+            # small integers, exact in bf16 with f32 accumulation.
+            wdt = jnp.bfloat16
+            table = jnp.concatenate(
+                [can_split.astype(jnp.float32)[:, None],
+                 rank.astype(jnp.float32)[:, None],
+                 bound_n.astype(jnp.float32)[:, None],
+                 jax.nn.one_hot(best_f, n_feat, dtype=jnp.float32)], axis=1,
+            )
+            route = jnp.einsum("nw,wc->nc", onehot.astype(wdt),
+                               table.astype(wdt),
+                               preferred_element_type=jnp.float32)
+            can_mine = route[:, 0] > 0.5
+            rank_mine = jnp.round(route[:, 1]).astype(jnp.int32)
+            bound_mine = jnp.round(route[:, 2]).astype(jnp.int32)
+            xbin_mine = jnp.round(
+                jnp.sum(bin_idx.astype(jnp.float32) * route[:, 3:], -1)
+            ).astype(jnp.int32)
+            xv_mine = jnp.sum(x * route[:, 3:], -1)
+        go_left = xbin_mine < bound_mine
+
+        if refine == "exact" and not random_splits:
+            # Sharpen each winner to the exact sklearn midpoint between the
+            # closest member values either side of the chosen bin edge.
+            # Routing is unchanged by construction — left members satisfy
+            # x <= edge < x of right members, so maxL <= edge < minR and
+            # the midpoint separates the same partition — hence structure,
+            # covers, and leaf values are bit-identical to refine="edge";
+            # only the stored threshold moves.
+            act = onehot & can_mine[:, None]           # [N, W]
+            mL = jnp.max(jnp.where(act & go_left[:, None],
+                                   xv_mine[:, None], -jnp.inf), axis=0)
+            mR = jnp.min(jnp.where(act & ~go_left[:, None],
+                                   xv_mine[:, None], jnp.inf), axis=0)
+            mid = ((mL + mR) * 0.5).astype(dt)
+            # sklearn's guard: a midpoint that rounds up to the right value
+            # falls back to the left value (threshold rule is x <= thr)
+            thr_ref = jnp.where(mid >= mR, mL, mid).astype(dt)
+            ok_ref = jnp.isfinite(mL) & jnp.isfinite(mR) & can_split
+            thr_node = jnp.where(ok_ref, thr_ref, thr_node)
+
         feature = _window_update(
             feature, p, jnp.where(can_split, best_f, -1), can_split
         )
@@ -755,35 +988,14 @@ def _fit_one_tree_hist(ohfb, bin_idx, edges, y01, w, key, *, random_splits,
         )
 
         # ---- child covers + depth, written at creation --------------------
+        # (the winning boundary's left stats + subtraction for the sibling,
+        # inside _emit_children — covers are never recounted from samples)
         child_vals, child_ok, j_safe = _emit_children(
             can_split, lw_b, lwy_b, tot_w, tot_wy
         )
         value = _window_update(value, a, child_vals, child_ok[:, None])
         depth = _window_update(depth, a, dep[j_safe] + 1, child_ok)
 
-        # ---- route samples via one per-node table lookup ------------------
-        # table rows: [can_split, rank, bound] ++ onehot(best_f) — all small
-        # integers, exact in bf16 with f32 accumulation. Same backend split
-        # as the histograms: table matmul on TPU (gathers serialize there),
-        # row gather on CPU.
-        table = jnp.concatenate(
-            [can_split.astype(jnp.float32)[:, None],
-             rank.astype(jnp.float32)[:, None],
-             bound_n[:, None], ohf], axis=1,
-        )
-        if use_segsum:
-            route = jnp.where(
-                inb[:, None], table[jnp.clip(rel, 0, bw - 1)], 0.0
-            )
-        else:
-            route = jnp.einsum("nw,wc->nc", onehot.astype(wdt),
-                               table.astype(wdt),
-                               preferred_element_type=jnp.float32)
-        can_mine = route[:, 0] > 0.5
-        rank_mine = jnp.round(route[:, 1]).astype(jnp.int32)
-        bound_mine = route[:, 2]
-        xbin_mine = jnp.sum(bin_idx.astype(jnp.float32) * route[:, 3:], -1)
-        go_left = xbin_mine < bound_mine
         child_mine = a + 2 * rank_mine + jnp.where(go_left, 0, 1)
         sample_node = jnp.where(
             inb & can_mine, child_mine, jnp.where(inb, -1, sample_node)
@@ -809,33 +1021,30 @@ def _fit_one_tree_hist(ohfb, bin_idx, edges, y01, w, key, *, random_splits,
     jax.jit,
     static_argnames=(
         "n_trees", "bootstrap", "random_splits", "sqrt_features", "max_depth",
-        "max_nodes", "tree_chunk", "n_bins", "hist_impl",
+        "max_nodes", "tree_chunk", "n_bins", "hist_impl", "node_batch",
+        "refine",
     ),
 )
-def fit_forest_hist(x, y, w, key, *, n_trees, bootstrap, random_splits,
-                    sqrt_features, max_depth=48, max_nodes=None,
-                    tree_chunk=None, n_bins=HIST_BINS, edges=None,
-                    tree_keys=None, hist_impl=None):
-    """Histogram-grower twin of ``fit_forest`` (same signature + ``n_bins``/
-    ``edges``). ``edges`` [F, n_bins-1] may be precomputed (e.g. once per
-    config from the full preprocessed matrix, shared across folds); derived
-    from ``x`` when None. Returns the same ``Forest`` structure, so predict
-    and Tree SHAP are grower-agnostic.
-
-    ``tree_keys`` [n_trees, 2] replaces the internal ``split(key, n_trees)``
-    so callers can grow a forest across several device dispatches (slices of
-    one key table) with bit-identical results — see sweep.py's
-    dispatch-chunked path."""
+def _fit_forest_hist_core(x, y, w, key, *, n_trees, bootstrap, random_splits,
+                          sqrt_features, max_depth, max_nodes, tree_chunk,
+                          n_bins, hist_impl, node_batch, refine,
+                          edges=None, tree_keys=None):
+    """The jitted grower program; every static is resolved by the
+    ``fit_forest_hist`` wrapper. Instrumented below, so host dispatches
+    emit ``cost`` events carrying the per-stage flop split."""
     n, f = x.shape
-    if max_nodes is None:
-        max_nodes = 2 * n
     max_features = max(1, int(f ** 0.5)) if sqrt_features else None
+    if hist_impl == "xla" and n >= _PACK:
+        # packed-f32 exactness needs per-node weight sums < _PACK; the bf16
+        # pair keeps exactness (f32 accumulation) at any N
+        hist_impl = "einsum"
 
     y01 = y.astype(x.dtype)
     w = w.astype(x.dtype)
     if edges is None:
         edges = quantile_edges(x, n_bins)
-    ohfb, bin_idx = _bin_onehot(x, edges)
+    oh_dt = jnp.float32 if hist_impl == "xla" else jnp.bfloat16
+    ohfb, bin_idx = _bin_onehot(x, edges, dtype=oh_dt)
 
     keys = jax.random.split(key, n_trees) if tree_keys is None else tree_keys
     assert keys.shape[0] == n_trees, (keys.shape, n_trees)
@@ -844,9 +1053,10 @@ def fit_forest_hist(x, y, w, key, *, n_trees, bootstrap, random_splits,
         kb, kg = jax.random.split(k)
         wt = _bootstrap_weights(w, kb) if bootstrap else w
         return _fit_one_tree_hist(
-            ohfb, bin_idx, edges, y01, wt, kg, random_splits=random_splits,
-            max_features=max_features, max_depth=max_depth,
-            max_nodes=max_nodes, hist_impl=hist_impl,
+            x, ohfb, bin_idx, edges, y01, wt, kg,
+            random_splits=random_splits, max_features=max_features,
+            max_depth=max_depth, max_nodes=max_nodes,
+            node_batch=node_batch, hist_impl=hist_impl, refine=refine,
         )
 
     feature, threshold, left, right, value, n_nodes = _map_trees(
@@ -854,6 +1064,74 @@ def fit_forest_hist(x, y, w, key, *, n_trees, bootstrap, random_splits,
     )
     return Forest(feature, threshold, left, right, value, n_nodes,
                   jnp.int32(max_depth))
+
+
+def fit_forest_hist(x, y, w, key, *, n_trees, bootstrap, random_splits,
+                    sqrt_features, max_depth=48, max_nodes=None,
+                    tree_chunk=None, n_bins=HIST_BINS, edges=None,
+                    tree_keys=None, hist_impl=None, node_batch=None,
+                    refine=None):
+    """Histogram-grower twin of ``fit_forest`` (same signature + ``n_bins``/
+    ``edges``/``hist_impl``/``node_batch``/``refine``). ``edges``
+    [F, n_bins-1] may be precomputed (e.g. once per config from the full
+    preprocessed matrix, shared across folds); derived from ``x`` when
+    None. Returns the same ``Forest`` structure, so predict and Tree SHAP
+    are grower-agnostic.
+
+    ``tree_keys`` [n_trees, 2] replaces the internal ``split(key, n_trees)``
+    so callers can grow a forest across several device dispatches (slices of
+    one key table) with bit-identical results — see sweep.py's
+    dispatch-chunked path.
+
+    ``hist_impl`` None resolves F16_HIST_IMPL, then auto by backend: "xla"
+    off-TPU, "pallas" on TPU unless the resilience ladder has this kernel's
+    pallas rung marked broken ("einsum"). A first-ever Mosaic failure under
+    auto degrades pallas -> einsum HERE (host dispatches only — under an
+    enclosing trace resolution is trace-time) and is remembered; an
+    EXPLICIT "pallas" still raises. ``node_batch``/``refine`` default from
+    the backend width heuristic and F16_HIST_REFINE; forests depend only on
+    data + key (impl and width neutral — refine="edge" moves thresholds)."""
+    if max_nodes is None:
+        max_nodes = 2 * x.shape[0]
+    if node_batch is None:
+        node_batch = (_cpu_node_batch(max_nodes)
+                      if jax.default_backend() == "cpu"
+                      else HIST_NODE_BATCH)
+    if refine is None:
+        refine = HIST_REFINE
+    explicit = hist_impl if hist_impl is not None else (
+        None if HIST_IMPL == "auto" else HIST_IMPL)
+    impl = _canon_hist_impl(explicit) if explicit else _auto_hist_impl()
+    if impl not in ("xla", "einsum", "pallas"):
+        raise ValueError(f"unknown hist impl {impl!r}")
+
+    def call(i):
+        return _fit_forest_hist_core(
+            x, y, w, key, n_trees=n_trees, bootstrap=bootstrap,
+            random_splits=random_splits, sqrt_features=sqrt_features,
+            max_depth=max_depth, max_nodes=max_nodes, tree_chunk=tree_chunk,
+            n_bins=n_bins, hist_impl=i, node_batch=node_batch, refine=refine,
+            edges=edges, tree_keys=tree_keys)
+
+    if explicit or impl != "pallas":
+        return call(impl)
+    leaves = jax.tree_util.tree_leaves((x, y, w, key, edges, tree_keys))
+    if any(isinstance(leaf, jax.core.Tracer) for leaf in leaves):
+        return call(impl)
+    try:
+        # block INSIDE the try: dispatch is async, so a Mosaic/runtime
+        # fault would otherwise surface at the caller's sync (same shape
+        # as treeshap's pallas -> xla rung)
+        return jax.block_until_ready(call("pallas"))
+    except Exception as e:  # Mosaic lowering/runtime errors share no base
+        # the pallas -> einsum rung of the degradation ladder: classify,
+        # emit the fault/degrade obs events, set the sticky per-kernel flag
+        _res_ladder.mark_pallas_broken(e, kernel="hist")
+        print(f"trees: hist pallas kernel failed on "
+              f"{jax.default_backend()} ({type(e).__name__}: "
+              f"{str(e)[:200]}); auto-falling back to hist_impl='einsum'",
+              file=sys.stderr, flush=True)
+        return call("einsum")
 
 
 def _map_trees(one, keys, n_trees, tree_chunk):
@@ -1089,12 +1367,27 @@ def predict(forest, x):
 
 # Cost attribution (obs/costs.py): host-level dispatches of the grower and
 # predict entry points emit ``cost`` events; calls from inside an enclosing
-# jit trace (the sweep's fused programs) pass through untouched.
-fit_forest_hist = _costs.instrument(
-    fit_forest_hist, "trees.fit_forest_hist",
+# jit trace (the sweep's fused programs) pass through untouched. The hist
+# core's events additionally carry the analytic per-stage flop split
+# (``stage_flops``) so ``report --attrib`` can split the fit wall into
+# bin / hist_build / split_scan / partition sub-stages.
+
+
+def _fit_hist_cost_fields(args, kwargs):
+    n, f = args[0].shape
+    return {"stage_flops": fit_stage_flops(
+        n=n, n_feat=f, n_bins=kwargs["n_bins"], n_trees=kwargs["n_trees"],
+        n_nodes=kwargs["max_nodes"], max_nodes=kwargs["max_nodes"],
+        node_batch=kwargs["node_batch"])}
+
+
+_fit_forest_hist_core = _costs.instrument(
+    _fit_forest_hist_core, "trees.fit_forest_hist",
     static_argnames=("n_trees", "bootstrap", "random_splits",
                      "sqrt_features", "max_depth", "max_nodes",
-                     "tree_chunk", "n_bins", "hist_impl"))
+                     "tree_chunk", "n_bins", "hist_impl", "node_batch",
+                     "refine"),
+    cost_fields=_fit_hist_cost_fields)
 fit_forest = _costs.instrument(
     fit_forest, "trees.fit_forest",
     static_argnames=("n_trees", "bootstrap", "random_splits",

@@ -76,17 +76,25 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
     if cap is None:
         cap = 2 * n  # SMOTE at worst doubles the training set
     max_nodes = 2 * cap
-    # Ensemble grower tier (decided at trace time, like the backend splits):
-    # - "hist" (default): the MXU histogram grower — the performance tier.
-    #   Binned splits act as a mild regularizer whose ensemble F1 reads
-    #   UNIFORMLY ABOVE sklearn's exact-split forests on the study data
-    #   (round-3/4 parity isolation: +0.07 no-SMOTE diagnostic, +0.018
-    #   probe config; bins-, quota-, and bootstrap-insensitive — an
-    #   architecture property, not a bug).
-    # - "exact": sklearn-semantics sort-based splits for ensembles too —
-    #   the parity tier (BASELINE.md ±0.01 is judged against this tier for
-    #   RF; DT always uses it). Slower: gather-bound, kept off the bench
-    #   path. ``grower`` overrides; F16_ENSEMBLE_GROWER is the env default.
+    # Grower tier (decided at trace time, like the backend splits):
+    # - "hist" (default, ensembles only): the histogram grower v2
+    #   (ops/trees.py section comment) — the performance tier, and since
+    #   in-step threshold refinement (F16_HIST_REFINE=exact) ALSO the
+    #   parity tier: candidate selection is bin-resolution but stored
+    #   thresholds are exact sklearn midpoints. Binned candidate selection
+    #   acts as a mild regularizer whose ensemble F1 reads AT-OR-ABOVE
+    #   sklearn's exact-split forests on the study data (round-3/4 parity
+    #   isolation: +0.07 no-SMOTE diagnostic, +0.018 probe config
+    #   pre-refinement; bins-, quota-, and bootstrap-insensitive).
+    # - single-tree DT keeps the exact grower even under the hist tier:
+    #   with no ensemble averaging to wash out bin-granular candidate
+    #   ranking, DT-on-hist diverged −0.066 on the small parity tier
+    #   (n=800) while RF/ET-on-hist stayed green. One exact tree is also
+    #   never the fit bottleneck, so there is no perf case for it.
+    # - "exact": sklearn-semantics sort-based splits for every config —
+    #   the fallback/reference tier (gather-bound, kept off the bench
+    #   path). ``grower`` overrides; F16_ENSEMBLE_GROWER is the env
+    #   default. PARITY.json records the shipped tier's probe deltas.
     g = grower or os.environ.get("F16_ENSEMBLE_GROWER", "hist")
     if g not in ("hist", "exact"):
         raise ValueError(
@@ -196,6 +204,33 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
             tree_keys_one, run_all_one)
 
 
+def _fit_cost_fields(spec, *, n, n_feat, cap, n_folds, grower):
+    """obs cost_fields hook for the fit-carrying kernels: stamps the
+    analytic grower sub-stage flop split (trees.fit_stage_flops) on each
+    compile's ``cost`` event, which is what lets ``report --attrib``
+    divide the measured fit wall into bin / hist_build / split_scan /
+    partition sub-stages. None for the exact tier (no sub-stage model) —
+    which includes single-tree DT under the hist tier (tier rule)."""
+    g = grower or os.environ.get("F16_ENSEMBLE_GROWER", "hist")
+    if spec.n_trees <= 1 or g != "hist":
+        return None
+    cap_r = 2 * n if cap is None else cap
+    max_nodes = 2 * cap_r
+
+    def fields(args, kwargs):
+        # chunked fit dispatches carry the per-chunk key table as the last
+        # positional arg ([(B,) folds, c, 2]); whole-ensemble dispatches
+        # grow spec.n_trees per fold
+        c = spec.n_trees
+        if args and getattr(args[-1], "ndim", 0) in (3, 4):
+            c = args[-1].shape[-2]
+        return {"stage_flops": trees.fit_stage_flops(
+            n=cap_r, n_feat=n_feat, n_bins=trees.HIST_BINS,
+            n_trees=c * n_folds, n_nodes=max_nodes, max_nodes=max_nodes)}
+
+    return fields
+
+
 def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
                 n_folds=N_FOLDS, tree_chunk=None, grower=None):
     """Build (cv_fit, cv_score) jitted for one model family.
@@ -217,11 +252,18 @@ def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
     )
     # Cost attribution (obs/costs.py): each jitted entry point's compiles
     # emit a ``cost`` event named for the kernel — transparent passthrough
-    # when telemetry is off.
+    # when telemetry is off. Fit-carrying kernels additionally stamp the
+    # grower's sub-stage flop split (_fit_cost_fields).
+    fit_fields = _fit_cost_fields(spec, n=n, n_feat=n_feat, cap=cap,
+                                  n_folds=n_folds, grower=grower)
     names = ("scores.fit", "scores.score", "scores.prep",
              "scores.fit_chunk", "scores.tree_keys", "scores.config")
-    return tuple(costs.instrument(jax.jit(f), nm)
-                 for f, nm in zip(fns, names))
+    carries_fit = {"scores.fit", "scores.fit_chunk", "scores.config"}
+    return tuple(
+        costs.instrument(jax.jit(f), nm,
+                         cost_fields=fit_fields if nm in carries_fit
+                         else None)
+        for f, nm in zip(fns, names))
 
 
 def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
@@ -293,7 +335,7 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
     # Replicated data arrays mix with config-varying codes inside
     # lax.switch; jax 0.9's varying-manual-axes validator rejects
     # that conservatively (its own error message says to disable).
-    def smap(f, in_specs, out_specs, name):
+    def smap(f, in_specs, out_specs, name, cost_fields=None):
         try:
             sm = jax.shard_map(f, mesh=mesh, in_specs=in_specs,
                                out_specs=out_specs, check_vma=False)
@@ -306,22 +348,26 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
                               out_specs=out_specs, check_rep=False)
         # ``name`` tags the SPMD program's compile-cost events
         # (obs/costs.py) with the kernel it serves.
-        return costs.instrument(jax.jit(sm), name)
+        return costs.instrument(jax.jit(sm), name, cost_fields=cost_fields)
 
+    fit_fields = _fit_cost_fields(spec, n=n, n_feat=n_feat, cap=None,
+                                  n_folds=n_folds, grower=grower)
     fit_b = smap(fit_batch, (P(), P(), pspec, pspec, pspec, pspec, pspec),
-                 (forest_specs, pspec, pspec), "scores.fit_batch")
+                 (forest_specs, pspec, pspec), "scores.fit_batch",
+                 cost_fields=fit_fields)
     prep_b = smap(prep_batch, (P(), P(), pspec, pspec, pspec, pspec, pspec),
                   (pspec, pspec, pspec, pspec, pspec, pspec),
                   "scores.prep_batch")
     fit_chunk_b = smap(fit_chunk_batch,
                        (pspec, pspec, pspec, pspec, pspec), forest_specs,
-                       "scores.fit_chunk_batch")
+                       "scores.fit_chunk_batch", cost_fields=fit_fields)
     tree_keys_b = smap(tree_keys_batch, (pspec,), pspec,
                        "scores.tree_keys_batch")
     score_b = smap(score_batch, (forest_specs, pspec, pspec, pspec, P()),
                    pspec, "scores.score_batch")
     all_b = smap(all_batch, (P(), P(), pspec, pspec, pspec, pspec, pspec,
-                             pspec, P()), pspec, "scores.config_batch")
+                             pspec, P()), pspec, "scores.config_batch",
+                 cost_fields=fit_fields)
     return fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b, all_b
 
 

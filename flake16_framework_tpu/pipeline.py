@@ -267,7 +267,7 @@ def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
         with obs.span("shap.config", key=(spec.name, "fused"), mode="fused",
                       stage="shap", config="/".join(config_keys)):
             fit_fn = _fused_shap_fit(n, spec, max_depth, 4 * n,
-                                     spec.n_trees > 1)
+                                     trees.hist_tier_default(spec.n_trees))
             xp, forest = fit_fn(x, y, prep, bal, key)
             x_explain = xp if n_explain is None else xp[:n_explain]
             out = np.asarray(
@@ -302,11 +302,13 @@ def shap_for_config(config_keys, feats, labels_raw, *, max_depth=48,
             sqrt_features=spec.sqrt_features,
             max_depth=max_depth, max_nodes=4 * n,
         )
-        if spec.n_trees > 1:
-            # Ensembles fit via the MXU histogram grower — same policy as
-            # the sweep (parallel/sweep.py _make_config_fns). A single
-            # unchunked 100-tree fit is one fold's worth of the sweep's
-            # 320-instance budget, so no tree_chunk is needed here.
+        if trees.hist_tier_default(spec.n_trees):
+            # Grower tier follows the sweep's rule (hist for ensembles
+            # unless F16_ENSEMBLE_GROWER=exact, single-tree DT stays
+            # exact; parallel/sweep.py _make_config_fns). A single
+            # unchunked 100-tree fit is one
+            # fold's worth of the sweep's 320-instance budget, so no
+            # tree_chunk is needed here.
             # ``fit_dispatch_trees`` splits the fit into bounded-duration
             # dispatches instead (bit-identical: explicit slices of the
             # same tree-key table).

@@ -7,7 +7,11 @@ Rungs, per fault class:
   sticky ``_PALLAS_AUTO_BROKEN`` flag — after an auto-mode kernel
   failure every later auto call takes the XLA formulation (same values;
   interpret-mode equality is test-pinned) instead of re-running the
-  broken Mosaic compile per chunk.
+  broken Mosaic compile per chunk. The flag is PER KERNEL
+  (``pallas_broken`` is a set of kernel names): the ISSUE-9 histogram
+  grower added a second Pallas kernel ("hist", beside "shap"), and one
+  kernel's Mosaic failure says nothing about the other's — each takes
+  its own rung down.
 - halve the chunk bounds (``halvings``): on oom / envelope-overrun the
   guard steps here before retrying. ``halved()`` is consulted by the
   sweep's dispatch bounds (parallel/sweep.py _dispatch_bounds,
@@ -39,12 +43,19 @@ MAX_HALVINGS = 6
 
 
 class DegradationState:
-    __slots__ = ("pallas_broken", "halvings", "cpu_fallback")
+    __slots__ = ("pallas_broken", "halvings", "cpu_fallback",
+                 "pallas_broken_kernels")
 
     def __init__(self):
+        # ``pallas_broken`` predates per-kernel rungs and stays a plain bool
+        # aliasing the "shap" kernel (ops/treeshap.py's _PallasBrokenProxy
+        # reads AND assigns it; serve/store.py gates on it). Kernels added
+        # later ("hist") live in the set so one kernel's Mosaic failure
+        # doesn't demote the others.
         self.pallas_broken = False
         self.halvings = 0
         self.cpu_fallback = False
+        self.pallas_broken_kernels = set()
 
 
 _STATE = DegradationState()
@@ -59,6 +70,7 @@ def reset():
     _STATE.pallas_broken = False
     _STATE.halvings = 0
     _STATE.cpu_fallback = False
+    _STATE.pallas_broken_kernels = set()
 
 
 def halved(chunk):
@@ -93,16 +105,29 @@ def step(fault_class, *, attempt=0, context=None):
     return action
 
 
-def mark_pallas_broken(exc=None):
-    """The pallas->xla rung (called from ops/treeshap.py's auto fallback).
+def pallas_broken(kernel="shap"):
+    """Is ``kernel``'s pallas->xla rung taken? Default "shap" reads the
+    legacy bool flag; other kernels ("hist") read the per-kernel set."""
+    if kernel == "shap":
+        return _STATE.pallas_broken
+    return kernel in _STATE.pallas_broken_kernels
+
+
+def mark_pallas_broken(exc=None, kernel="shap"):
+    """The pallas->xla rung, per kernel (ops/treeshap.py's auto fallback
+    for "shap", ops/trees.py's hist-grower fallback for "hist").
     Returns True on the FIRST marking — callers use that to warn once."""
-    if _STATE.pallas_broken:
+    if pallas_broken(kernel):
         return False
-    _STATE.pallas_broken = True
+    if kernel == "shap":
+        _STATE.pallas_broken = True
+    else:
+        _STATE.pallas_broken_kernels.add(kernel)
     obs.event("fault",
               fault_class=(faults.classify(exc) if exc is not None
                            else faults.DETERMINISTIC),
               action="degrade", attempt=0, step="pallas-to-xla",
+              kernel=kernel,
               error=str(exc)[:200] if exc is not None else "")
     return True
 

@@ -138,7 +138,10 @@ def fit_model(config_keys, feats, labels_raw, *, max_depth=48,
         random_splits=spec.random_splits, sqrt_features=spec.sqrt_features,
         max_depth=max_depth, max_nodes=4 * n,
     )
-    forest = (trees.fit_forest_hist if spec.n_trees > 1
+    # Grower tier follows the sweep's rule (hist for ensembles unless
+    # F16_ENSEMBLE_GROWER=exact; single-tree DT stays exact) so served
+    # artifacts match swept models.
+    forest = (trees.fit_forest_hist if trees.hist_tier_default(spec.n_trees)
               else trees.fit_forest)(xs, ys, ws, kf, **fit_kw)
 
     # One registration-time host sync (cold path, never per request):

@@ -152,8 +152,8 @@ def ours_config_f1s(feats, labels, pids, keys, *, n_trees, seeds,
     """Our jitted sweep for one config across seeds. One engine serves all
     seeds: the PRNG key is a traced argument of the compiled CV program
     (sweep.py run_config), so varying ``engine.seed`` hits the jit cache.
-    ``grower`` selects the ensemble tier ("hist" default / "exact" parity
-    tier — sweep.py _make_config_fns)."""
+    ``grower`` selects the tier ("hist" production default / "exact"
+    ladder-fallback tier — sweep.py _make_config_fns)."""
     from bench import dispatch_env as _dispatch_env
     from flake16_framework_tpu.parallel.sweep import SweepEngine
 
@@ -197,19 +197,27 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
     CPU side takes ~1 h single-core at full size, so it can be produced
     once and reused across ours-side (TPU) runs. Sizes must match.
 
-    ``exact_tier_models``: model names whose CRITERION row runs the exact
-    grower tier (sweep.py ``grower="exact"`` — sklearn-semantics splits for
-    ensembles). The default hist tier is still measured and recorded in the
-    row's ``default_tier`` sub-dict: the histogram grower's binned splits
-    are a mild regularizer whose ensemble F1 reads uniformly ABOVE sklearn
-    on this data (round-3/4 isolation — bins-, quota- and bootstrap-
-    insensitive), so the ±0.01 criterion is judged where like is compared
-    with like, and the production tier's (favorable) deviation is published
-    beside it rather than hidden. ``k_exact`` bounds the exact-tier seed
-    count (default ``k_ours``); ``ours_exact_cache`` is the ours-side twin
-    of ``sklearn_cache`` (the exact grower costs ~1.5 h/seed on one CPU
-    core, so wall-limited runs reuse seeds measured out-of-band — source
-    and precision provenance recorded in the criterion row)."""
+    The CRITERION row is the shipped tier for every config — the tier
+    that carries the bench number: hist for ensembles (RF/ET), exact for
+    the single-tree DT (the sweep's tier rule). Rounds 3-6 could not say
+    that for the ensembles: the histogram grower's raw bin-edge
+    thresholds acted as a mild regularizer reading uniformly ABOVE
+    sklearn (RF +0.0197, double the budget), so the criterion was judged
+    on the exact grower with the hist delta published beside it. ISSUE
+    9's exact-split refinement (hist node discovery, sklearn midpoint on
+    the winning feature) closed that split for the ensembles; DT-on-hist
+    still diverged (−0.066 small tier — no averaging to wash out
+    bin-granular candidate ranking), so DT keeps the exact grower.
+
+    ``exact_tier_models``: model names to ALSO measure on the exact
+    (ladder-fallback) grower tier (sweep.py ``grower="exact"``),
+    published in the row's ``exact_tier`` sub-dict — evidence the
+    fallback tier still agrees, not the criterion. ``k_exact`` bounds
+    its seed count (default ``k_ours``); ``ours_exact_cache`` is the
+    ours-side twin of ``sklearn_cache`` (the exact grower costs
+    ~1.5 h/seed on one CPU core, so wall-limited runs reuse seeds
+    measured out-of-band — source and precision provenance recorded in
+    the sub-dict)."""
     from flake16_framework_tpu.utils.synth import make_dataset
 
     params = dict(n_tests=n_tests, n_trees=n_trees, data_seed=data_seed,
@@ -268,8 +276,11 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
         deterministic = keys[4] == "Decision Tree" and "SMOTE" not in keys[3]
         ko = 1 if deterministic else k_ours
         # grower="hist" EXPLICITLY: this row is labeled as the production
-        # tier below, so it must not silently inherit F16_ENSEMBLE_GROWER
-        # (single-tree DT ignores the arg — always the exact grower).
+        # tier below, so it must not silently inherit F16_ENSEMBLE_GROWER.
+        # The sweep's tier rule applies the hist grower to ensembles only;
+        # the single-tree DT config routes to the exact grower under this
+        # same call (DT-on-hist diverged −0.066 on the small tier), so
+        # every criterion row still measures the shipped fit path.
         ours = ours_config_f1s(feats, labels, pids, keys,
                                n_trees=n_trees, seeds=range(ko),
                                grower="hist")
@@ -305,9 +316,10 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
             }
 
         entry = side(o)
-        # DT runs the exact grower by construction (n_trees=1); ensembles
-        # run whatever tier measured them.
-        entry["grower"] = "exact" if keys[4] == "Decision Tree" else "hist"
+        # the tier that measured this row — hist for ensembles; the
+        # single-tree DT stays on the exact grower (sweep tier rule)
+        entry["grower"] = ("exact" if keys[4] == "Decision Tree"
+                           else "hist")
         if keys[4] in exact_tier_models and keys[4] != "Decision Tree":
             kx = k_exact or k_ours
             ox, src = None, "computed"
@@ -355,8 +367,12 @@ def run_parity(*, n_tests, n_trees, k_ours, k_sk, data_seed=7,
             # the REQUESTED seed count, so a record judged on an
             # operator-lowered PARITY_K_EXACT is visibly under-default
             exact_entry["k_exact_requested"] = kx
-            # criterion row = exact tier; production tier published beside
-            entry = dict(exact_entry, default_tier=entry)
+            # Criterion row = the shipped (production/bench) tier — hist
+            # for ensembles since the ISSUE-9 refinement; the exact
+            # grower is the ensembles' ladder-fallback tier and its
+            # measurement, when requested, is published BESIDE the
+            # criterion, not as it.
+            entry["exact_tier"] = exact_entry
         report["/".join(keys)] = entry
         print(json.dumps({keys[4]: entry}), flush=True)
     return report
@@ -395,16 +411,21 @@ def main():
         gen_cache(out_path)
         return
     if full:
+        # The criterion tier is hist — the production/bench tier — for
+        # every config (run_parity docstring). The exact fallback tier is
+        # measured beside it only when requested: PARITY_EXACT_TIER_MODELS
+        # ("Random Forest,Extra Trees"-style) names the rows, and the
+        # seeds come from PARITY_OURS_EXACT_CACHE when present (the exact
+        # grower costs ~40+ min/seed on one CPU core at full size;
+        # PARITY_K_EXACT trades seeds for completion).
+        exact_models = tuple(
+            m.strip() for m in
+            os.environ.get("PARITY_EXACT_TIER_MODELS", "").split(",")
+            if m.strip())
         rep = run_parity(
             n_tests=4000, n_trees=100, k_ours=6, k_sk=6,
             sklearn_cache=os.environ.get("PARITY_SKLEARN_CACHE"),
-            # RF's criterion row runs the exact (sklearn-semantics) grower
-            # tier; the hist tier's uniformly-upward deviation is recorded
-            # in its default_tier sub-dict (see run_parity docstring).
-            # PARITY_K_EXACT bounds the exact-tier seed count — the exact
-            # grower costs ~40+ min/seed on one CPU core at full size, so
-            # wall-limited runs can trade seeds for completion.
-            exact_tier_models=("Random Forest",),
+            exact_tier_models=exact_models,
             k_exact=int(os.environ.get("PARITY_K_EXACT", "6")),
             ours_exact_cache=os.environ.get("PARITY_OURS_EXACT_CACHE"),
         )
@@ -418,17 +439,19 @@ def main():
                # (bit-pinned hist formulations, backend-deterministic PRNG)
                # but the record must say which backend ran the ours side
                "ours_backend": jax.default_backend(),
-               # Self-describing tier flags (round-4 advisor): top-level
-               # ok judges the CRITERION (exact) tier; whether the shipped
-               # production (hist) tier also fits the tolerance is stated
-               # here so a machine consumer reading only ok+tolerance
-               # cannot mistake one for the other. Seed-count provenance:
-               # an ok judged on fewer exact seeds than the 6-seed default
-               # is visibly under-default.
-               "criterion_tier": "exact",
-               "default_tier_within_tol": all(
-                   abs(v["default_tier"]["delta"]) <= tol
-                   for v in rep.values() if "default_tier" in v),
+               # Self-describing tier flags (round-4 advisor, flipped by
+               # ISSUE 9): top-level ok judges the CRITERION tier, which
+               # since the refinement IS the shipped production/bench
+               # tier — hist for ensembles, exact for single-tree DT
+               # (per-row "grower" says which); any measured
+               # exact-fallback rows are judged separately here so a
+               # machine consumer reading only ok+tolerance cannot
+               # mistake one for the other.
+               "criterion_tier": "hist-ensembles",
+               "exact_tier_models": list(exact_models),
+               "exact_tier_within_tol": all(
+                   abs(v["exact_tier"]["delta"]) <= tol
+                   for v in rep.values() if "exact_tier" in v),
                "k_exact": k_exact, "k_exact_default": 6,
                "ok": all(abs(v["delta"]) <= tol for v in rep.values())}
         # Atomic replace: a kill mid-dump must never corrupt an existing
@@ -449,16 +472,18 @@ def run_small_tier():
     """The CPU regression tier (shared by ``python parity.py`` and pytest):
     same machinery as --full, sized for CI, tolerance scaled to its own
     measured noise (at this size sklearn's seed sd alone exceeds 0.01).
-    RF runs the exact criterion tier here too, so the --full criterion
-    path (exact-grower ensembles through the chunked sweep) is exercised
-    end-to-end on every CI run, not first on the TPU."""
+    The criterion rows run the shipped tier like --full (hist ensembles,
+    exact single-tree DT); RF ALSO measures the exact fallback tier so
+    that path (exact-grower
+    ensembles through the chunked sweep) stays exercised end-to-end on
+    every CI run, not first on the TPU."""
     rep = run_parity(n_tests=800, n_trees=16, k_ours=2, k_sk=4,
                      exact_tier_models=("Random Forest",))
     for name, v in rep.items():
         tol = max(0.05, 3 * v["se_delta"])
         assert abs(v["delta"]) <= tol, (name, v)
-        if "default_tier" in v:
-            d = v["default_tier"]
+        if "exact_tier" in v:
+            d = v["exact_tier"]
             assert abs(d["delta"]) <= max(0.05, 3 * d["se_delta"]), (name, d)
     return rep
 

@@ -227,6 +227,43 @@ def test_span_collision_detected():
     assert "scores.fit" in findings[0].message
 
 
+def test_knob_census_flags_undeclared_read():
+    mod = Module("mod_k.py",
+                 src="import os\nv = os.environ.get('F16_BOGUS_KNOB')\n")
+    findings = [f for f in rules_grid.check_project([mod])
+                if f.rule == "G106"]
+    assert len(findings) == 1
+    assert "F16_BOGUS_KNOB" in findings[0].message
+    assert findings[0].line == 2
+
+
+def test_knob_census_package_reads_are_all_declared():
+    # the census over the real package: every F16_* read resolves to a
+    # KNOBS entry and no entry is stale (the CI-gate invariant, asserted
+    # directly so a failure names the knob rather than just exiting 1)
+    import glob
+
+    mods = [Module(p) for p in glob.glob(
+        os.path.join(PACKAGE, "**", "*.py"), recursive=True)]
+    findings = [f for f in rules_grid.check_project(mods)
+                if f.rule == "G106"]
+    assert findings == [], [f.message for f in findings]
+
+
+def test_knob_value_preflight_rejects_bad_grower_arm():
+    # model-changing grower knobs: a typo'd A/B arm or bad bin count must
+    # fail the host-side pre-flight, valid arms must pass
+    bad = rules_grid.preflight_knob_values(
+        {"F16_ENSEMBLE_GROWER": "hsit", "F16_HIST_BINS": "one",
+         "F16_HIST_IMPL": "cuda", "F16_HIST_NODE_BATCH": "0"})
+    assert {"G106"} == {f.rule for f in bad} and len(bad) == 4
+    good = rules_grid.preflight_knob_values(
+        {"F16_ENSEMBLE_GROWER": "exact", "F16_HIST_BINS": "128",
+         "F16_HIST_IMPL": "segsum", "F16_HIST_REFINE": "edge",
+         "F16_ET_DRAW": "rank", "PATH": "/bin"})
+    assert good == []
+
+
 def test_o104_reverse_flags_dead_schema_kind(monkeypatch, tmp_path):
     """A kind declared in schema.EVENT_FIELDS that no linted module emits
     is dead schema — the reverse O104 direction, anchored on the

@@ -184,13 +184,14 @@ def test_hist_impl_formulations_agree_bitwise():
                                       np.asarray(getattr(b, f)), err_msg=f)
 
 
-def test_hist_node_batch_width_is_results_neutral(monkeypatch):
+def test_hist_node_batch_width_is_results_neutral():
     # Per-node RNG keys derive from global node ids, not the window start,
     # so the node-batch width (a backend-tuned perf knob) must not change
     # the grown forest: a hardware tuning sweep may ship any width without
-    # a parity re-check, and CPU (16) vs TPU (128) fits stay reproducible.
-    from flake16_framework_tpu.ops import trees as trees_mod
-
+    # a parity re-check, and CPU (8/16) vs TPU (128) fits stay reproducible.
+    # ``node_batch`` is an explicit static of the grower since v2 (the host
+    # wrapper resolves F16_HIST_NODE_BATCH* into it), so the knob path and
+    # the A/B here are the same code path.
     rng = np.random.RandomState(23)
     n = 300
     x = rng.randn(n, 12).astype(np.float32)
@@ -198,14 +199,11 @@ def test_hist_node_batch_width_is_results_neutral(monkeypatch):
     w = np.ones(n, np.float32)
     kw = dict(n_trees=4, bootstrap=True, sqrt_features=True,
               max_depth=10, max_nodes=400)
-    fit_unjit = fit_forest_hist.__wrapped__  # re-trace so the knob is re-read
     for random_splits in (False, True):
-        got = []
-        for bw in (16, 128):
-            monkeypatch.setattr(trees_mod, "HIST_NODE_BATCH_CPU", bw)
-            monkeypatch.setattr(trees_mod, "HIST_NODE_BATCH", bw)
-            got.append(fit_unjit(x, y, w, jax.random.PRNGKey(11),
-                                 random_splits=random_splits, **kw))
+        got = [fit_forest_hist(x, y, w, jax.random.PRNGKey(11),
+                               random_splits=random_splits, node_batch=bw,
+                               **kw)
+               for bw in (16, 128)]
         a, b = got
         for f in a._fields:
             np.testing.assert_array_equal(
@@ -236,3 +234,79 @@ def test_predict_windows_matches_gather():
         a = np.asarray(predict_proba(forest, xq, impl="gather"))
         b = np.asarray(predict_proba(forest, xq, impl="windows"))
         np.testing.assert_array_equal(a, b, err_msg=str(fit))
+
+
+def test_hist_refine_exact_moves_only_thresholds():
+    # Exact-split refinement replaces the winning bin-edge threshold with
+    # the midpoint of the straddling data values on the SAME feature; by
+    # construction (mL <= edge < mR) that moves no training row across the
+    # split, so structure, covers and class values must stay bit-equal to
+    # refine="edge" — only thresholds may (and must) differ.
+    x, y = _data(300, seed=4)
+    w = np.ones(len(y))
+    kw = dict(n_trees=8, bootstrap=True, random_splits=False,
+              sqrt_features=True, max_depth=12, max_nodes=600)
+    a = fit_forest_hist(x, y, w, jax.random.PRNGKey(5), refine="edge", **kw)
+    b = fit_forest_hist(x, y, w, jax.random.PRNGKey(5), refine="exact", **kw)
+    assert not np.array_equal(np.asarray(a.threshold),
+                              np.asarray(b.threshold))
+    for f in a._fields:
+        if f == "threshold":
+            continue
+        np.testing.assert_array_equal(np.asarray(getattr(a, f)),
+                                      np.asarray(getattr(b, f)), err_msg=f)
+    # In-bag routing is unchanged, so with every row in-bag (no bootstrap)
+    # train predictions agree exactly. (Under bootstrap, out-of-bag rows
+    # sit outside the mL/mR envelope and MAY flip sides — that freedom is
+    # precisely how refinement moves held-out F1 toward sklearn's.)
+    kw["bootstrap"] = False
+    a = fit_forest_hist(x, y, w, jax.random.PRNGKey(5), refine="edge", **kw)
+    b = fit_forest_hist(x, y, w, jax.random.PRNGKey(5), refine="exact", **kw)
+    np.testing.assert_array_equal(np.asarray(predict_proba(a, x)),
+                                  np.asarray(predict_proba(b, x)))
+
+
+def test_hist_pallas_fallback_degrades_through_ladder(monkeypatch, capsys):
+    # The hist kernel's pallas->einsum rung (fault-injection drill, the
+    # treeshap kernel's test shape): a Mosaic failure under auto falls back
+    # once, marks the per-kernel rung sticky (no re-attempt per call), never
+    # masks an explicit impl="pallas", and leaves the shap rung untouched.
+    from flake16_framework_tpu.ops import trees
+    from flake16_framework_tpu.resilience import ladder
+
+    x, y = _data(200, seed=12)
+    w = np.ones(len(y), np.float32)
+    kw = dict(n_trees=3, bootstrap=True, random_splits=False,
+              sqrt_features=True, max_depth=8, max_nodes=200,
+              node_batch=16)
+
+    calls = []
+
+    def boom(*a, **k):
+        calls.append(1)
+        raise RuntimeError("mosaic says no")
+
+    monkeypatch.setattr(trees, "_pallas_cum_hists", boom)
+    monkeypatch.setattr(trees.jax, "default_backend", lambda: "tpu")
+    ladder.state().pallas_broken_kernels.discard("hist")
+    try:
+        want = fit_forest_hist(x, y, w, jax.random.PRNGKey(2),
+                               hist_impl="einsum", **kw)
+        got = fit_forest_hist(x, y, w, jax.random.PRNGKey(2), **kw)
+        for f in want._fields:
+            np.testing.assert_array_equal(np.asarray(getattr(got, f)),
+                                          np.asarray(getattr(want, f)),
+                                          err_msg=f)
+        assert len(calls) == 1 and ladder.pallas_broken("hist")
+        assert "falling back" in capsys.readouterr().err
+        # second auto call: straight to einsum, no new kernel attempt
+        fit_forest_hist(x, y, w, jax.random.PRNGKey(2), **kw)
+        assert len(calls) == 1
+        # explicit pallas still surfaces the real error
+        with pytest.raises(RuntimeError, match="mosaic"):
+            fit_forest_hist(x, y, w, jax.random.PRNGKey(2),
+                            hist_impl="pallas", **kw)
+        # the default (shap) rung is per-kernel-isolated from this drill
+        assert ladder.state().pallas_broken is False
+    finally:
+        ladder.state().pallas_broken_kernels.discard("hist")

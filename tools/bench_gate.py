@@ -50,8 +50,15 @@ RATIO_FLOOR = 0.65   # higher-is-better metrics: cur >= floor * ref
 RATIO_CEIL = 1.75    # lower-is-better walls:    cur <= ceil * ref
 PER_CONFIG_CEIL = 2.0
 
-HIGHER_BETTER = ("value", "scores_speedup", "shap_speedup", "serve_rps")
-LOWER_BETTER = ("t_ours_scores_s", "t_ours_shap_s", "serve_p99_ms")
+# fit_gflops / t_ours_fit_s (round 7+, the ISSUE-9 fit ratchet): the fit
+# stage's analytic-flop throughput and wall. fit_gflops is absent from
+# rounds <= r06 so it passes vacuously against them (the "new metric"
+# rule below); t_ours_fit_s is present in r05's detail, so a fit-wall
+# blowup vs the last comparable round fails the gate from round 7 on.
+HIGHER_BETTER = ("value", "scores_speedup", "shap_speedup", "serve_rps",
+                 "fit_gflops")
+LOWER_BETTER = ("t_ours_scores_s", "t_ours_shap_s", "t_ours_fit_s",
+                "serve_p99_ms")
 
 
 def load_history(repo=REPO):

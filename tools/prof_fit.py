@@ -1,0 +1,193 @@
+"""Re-runnable fit profiler: where does tree-growth wall time go?
+
+    python tools/prof_fit.py [--n 400] [--trees 25] [--reps 2]
+                             [--growers hist,exact] [--impls auto]
+                             [--models DT,RF,ET] [--engine-only] [--json]
+
+Three measurement layers, cheapest-first (all steady-state: every timed
+call runs once untimed to absorb compiles):
+
+1. **Engine walls** — ``SweepEngine.run_config`` per bench config
+   (bench.py CONFIGS at the bench shape), the exact number the bench's
+   ``t_ours_fit_s`` aggregates. Run per grower tier so hist-vs-exact is
+   one flag, not a code edit.
+2. **Grower kernel** — ``trees.fit_forest_hist`` called directly at the
+   fold-collapsed shape (n_trees x folds growths in one dispatch, the
+   sweep's own layout), per ``hist_impl``. Isolates the grower from
+   preprocess/resample/predict, so sweep overhead can't masquerade as
+   grower time.
+3. **Stage split** — the analytic per-stage flop model
+   (``trees.fit_stage_flops``: bin / hist_build / split_scan /
+   partition) scaled onto the measured kernel wall — the same
+   attribution ``report --attrib`` renders from cost events, printed
+   here without a telemetry session.
+
+History: this pattern started as _scratch throwaway scripts during the
+round-3 TPU profiling session (PROFILE.md); promoted to tools/ so the
+next fit bottleneck hunt starts from a command, not an archaeology dig.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MODEL_ABBREV = {"DT": "Decision Tree", "RF": "Random Forest",
+                "ET": "Extra Trees"}
+
+
+def _steady(fn, reps):
+    """Wall of ``fn`` after one untimed warm-up (compile + first-touch)."""
+    fn()
+    walls = []
+    for _ in range(reps):
+        t0 = time.time()
+        fn()
+        walls.append(time.time() - t0)
+    return min(walls)
+
+
+def engine_walls(n_tests, n_trees, growers, models, reps):
+    """Layer 1: per-config fit/predict walls through the bench engine."""
+    import bench
+    from flake16_framework_tpu.parallel import sweep
+
+    feats, labels, projects, names, pids = bench.make_data(n_tests)
+    configs = [k for k in bench.CONFIGS if k[4] in models]
+    out = {}
+    for grower in growers:
+        overrides = {"Random Forest": n_trees, "Extra Trees": n_trees}
+        engine = sweep.SweepEngine(
+            feats, labels, projects, names, pids, tree_overrides=overrides,
+            dispatch_trees=bench.DISPATCH_TREES, grower=grower,
+        )
+        rows = {}
+        for keys in configs:
+            res0 = engine.run_config(keys)  # compile pass
+            fit = pred = None
+            for _ in range(reps):
+                res = engine.run_config(keys)
+                f, p = res[0] * engine.n_folds, res[1] * engine.n_folds
+                fit = f if fit is None else min(fit, f)
+                pred = p if pred is None else min(pred, p)
+            rows["/".join(keys)] = {
+                "fit_s": round(fit, 3), "predict_s": round(pred, 3),
+                "fit_cold_s": round(res0[0] * engine.n_folds, 3),
+            }
+        rows["TOTAL"] = {
+            "fit_s": round(sum(r["fit_s"] for r in rows.values()), 3),
+            "predict_s": round(sum(r["predict_s"] for r in rows.values()), 3),
+        }
+        out[grower] = rows
+    return out
+
+
+def kernel_walls(n_tests, n_trees, impls, reps, stage_split=True):
+    """Layers 2+3: direct grower-kernel walls at the fold-collapsed sweep
+    shape, with the analytic stage split scaled onto the measured wall."""
+    import jax
+    import jax.numpy as jnp
+
+    from flake16_framework_tpu.ops import trees
+    from flake16_framework_tpu.parallel.sweep import N_FOLDS
+
+    n = n_tests
+    cap = 2 * n                      # sweep _make_config_fns: SMOTE cap
+    max_nodes = 2 * cap
+    f = 16                           # Flake16 feature set
+    key = jax.random.PRNGKey(0)
+    kx, kw, kf = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (cap, f), jnp.float32)
+    y = jax.random.bernoulli(kf, 0.3, (cap,))
+    # fold-mask-shaped weights: ~n live rows of the padded cap
+    w = (jax.random.uniform(kw, (cap,)) < (0.9 * n / cap)).astype(jnp.float32)
+    edges = trees.quantile_edges(x)
+
+    t_total = n_trees * N_FOLDS      # growths per config dispatch
+    out = {}
+    for model, random_splits, bootstrap in (
+        ("RF", False, True), ("ET", True, False),
+    ):
+        for impl in impls:
+            hist_impl = None if impl == "auto" else impl
+
+            def run():
+                forest = trees.fit_forest_hist(
+                    x, y, w, key, n_trees=t_total, bootstrap=bootstrap,
+                    random_splits=random_splits, sqrt_features=True,
+                    max_nodes=max_nodes, edges=edges, hist_impl=hist_impl,
+                )
+                jax.block_until_ready(forest)
+                return forest
+
+            wall = _steady(run, reps)
+            rec = {"wall_s": round(wall, 3), "growths": t_total}
+            if stage_split and hasattr(trees, "fit_stage_flops"):
+                forest = run()
+                n_nodes = int(jnp.max(forest.n_nodes))
+                fl = trees.fit_stage_flops(
+                    n=cap, n_feat=f, n_bins=trees.HIST_BINS,
+                    n_trees=t_total, n_nodes=n_nodes, max_nodes=max_nodes,
+                )
+                tot = sum(fl.values()) or 1.0
+                rec["stage_split_s"] = {
+                    k: round(wall * v / tot, 4) for k, v in fl.items()}
+                rec["max_n_nodes"] = n_nodes
+            out[f"{model}/{impl}"] = rec
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--n", type=int, default=400, help="bench n_tests")
+    ap.add_argument("--trees", type=int, default=25, help="bench n_trees")
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--growers", default="hist,exact")
+    ap.add_argument("--impls", default="auto",
+                    help="comma list of hist_impl values for the kernel "
+                         "layer (auto,xla,einsum,pallas)")
+    ap.add_argument("--models", default="DT,RF,ET")
+    ap.add_argument("--engine-only", action="store_true")
+    ap.add_argument("--kernel-only", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+    models = [MODEL_ABBREV.get(m.strip(), m.strip())
+              for m in args.models.split(",") if m.strip()]
+    result = {"n_tests": args.n, "n_trees": args.trees,
+              "backend": jax.default_backend()}
+    if not args.kernel_only:
+        result["engine"] = engine_walls(
+            args.n, args.trees, [g.strip() for g in args.growers.split(",")],
+            models, args.reps)
+    if not args.engine_only:
+        result["kernel"] = kernel_walls(
+            args.n, args.trees,
+            [i.strip() for i in args.impls.split(",")], args.reps)
+
+    if args.json:
+        print(json.dumps(result, indent=1))
+        return 0
+    print(f"backend={result['backend']} n={args.n} trees={args.trees}")
+    for grower, rows in result.get("engine", {}).items():
+        print(f"\n[engine grower={grower}]")
+        for cfgname, r in rows.items():
+            cold = f" cold={r['fit_cold_s']}" if "fit_cold_s" in r else ""
+            print(f"  {cfgname:55s} fit={r['fit_s']:7.3f}s "
+                  f"predict={r['predict_s']:6.3f}s{cold}")
+    for name, rec in result.get("kernel", {}).items():
+        split = rec.get("stage_split_s")
+        extra = (" " + " ".join(f"{k}={v}s" for k, v in split.items())
+                 if split else "")
+        print(f"[kernel {name:10s}] wall={rec['wall_s']}s "
+              f"({rec['growths']} growths){extra}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
