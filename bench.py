@@ -389,6 +389,49 @@ def worker(n_tests, n_trees):
         "dispatch_trees": DISPATCH_TREES, "backend": jax.default_backend(),
     }), flush=True)
 
+    # Journal stage (ISSUE 11): the write-ahead journal's two costs at
+    # this probe's scale, bounded against the fit wall just measured.
+    # Appends are fsync-bound, not compute-bound, so no refit is needed:
+    # write the exact (config x fold) record stream a journaled run of
+    # these CONFIGS produces (same [m, P, 3] int32 fold-count payloads),
+    # then time the recovery replay a preempted run pays before its first
+    # dispatch. Acceptance bound: journal_overhead_pct <= 2% of fit wall.
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from flake16_framework_tpu.resilience import journal as rjournal
+
+    jdir = tempfile.mkdtemp(prefix="f16-bench-journal-")
+    jpath = os.path.join(jdir, "scores.pkl.journal")
+    try:
+        fold_counts = np.zeros((8, len(engine.project_names), 3), np.int32)
+        key_bytes = np.zeros(2, np.uint32).tobytes()
+        jr = rjournal.SweepJournal.open(jpath, "bench", warn_out=None)
+        for keys in CONFIGS:
+            for fold in range(engine.n_folds):
+                jr.record_fold(keys, fold, key_bytes, fold_counts)
+            jr.record_config(keys, per_config["/".join(keys)])
+        journal_append_s = jr.append_wall_s
+        n_appends = jr.n_appends
+        jr.close(remove=False)
+        t0 = time.time()
+        rep = rjournal.replay(jpath, fingerprint="bench", warn_out=None)
+        resume_overhead_s = time.time() - t0
+        assert len(rep.ledger) == len(CONFIGS)
+    finally:
+        shutil.rmtree(jdir, ignore_errors=True)
+    journal_rec = {
+        "journal_append_s": round(journal_append_s, 4),
+        "journal_appends": n_appends,
+        "journal_overhead_pct": round(100 * journal_append_s / t_fit, 3)
+        if t_fit else None,
+        "resume_overhead_s": round(resume_overhead_s, 4),
+    }
+    print(json.dumps({"stage": "journal", **journal_rec,
+                      "t_fit": round(t_fit, 3)}), flush=True)
+
     # SHAP stage. Default impl "auto" = the Pallas kernel on TPU, XLA
     # elsewhere; BENCH_SHAP_IMPL overrides so a hardware A/B (hw_probe
     # tune_shap's xla arm) can ship its winner without a code change.
@@ -422,6 +465,7 @@ def worker(n_tests, n_trees):
         "t_scores": round(t_scores, 3), "t_shap": round(t_shap, 3),
         "t_fit": round(t_fit, 3), "t_predict": round(t_pred, 3),
         "fit_flops": fit_flops,
+        **journal_rec,
         "per_config_s": per_config,
         "per_config_shap_s": per_config_shap,
         "dispatch_trees": DISPATCH_TREES,
@@ -847,6 +891,11 @@ def main():
         dispatch_trees=result.get("dispatch_trees"),
         bench_batch=result.get("bench_batch"),
         bench_fused=result.get("bench_fused"),
+        # Crash-tolerance costs (ISSUE 11): fsync'd journal appends as a
+        # fraction of the fit wall (acceptance bound <= 2%) and the
+        # replay wall a preempted run pays before its first dispatch.
+        journal_overhead_pct=result.get("journal_overhead_pct"),
+        resume_overhead_s=result.get("resume_overhead_s"),
         scores_speedup=round(sum(t_base_scores) / result["t_scores"], 3)
         if result["t_scores"] else None,
         shap_speedup=round(sum(t_base_shap) / result["t_shap"], 3)

@@ -27,7 +27,18 @@ plus extension verbs the reference lacks:
     python -m flake16_framework_tpu serve [--ledger scores.pkl] [--json]
         # always-on scoring service (serve/): AOT-warmed predict+SHAP
         # executables, microbatched async queue, model registry; drives
-        # a closed-loop client load and prints throughput + p50/p99
+        # a closed-loop client load and prints throughput + p50/p99.
+        # SIGTERM (--hold mode) triggers a graceful drain: admission
+        # close -> in-flight complete -> queued requests get a
+        # RETRIABLE rejection -> registry/AOT-manifest flush, with a
+        # --drain-deadline that escalates to checkpoint-and-abort
+    python -m flake16_framework_tpu resume [lopo] [fused] [dispatch=N]
+        # continue a preempted `scores` sweep from its write-ahead
+        # journal (<scores.pkl>.journal; fold-granular, fsync'd):
+        # completed configs and folds replay, only unfinished
+        # (config, fold) pairs rerun with identical rng keys, so the
+        # final pickle is bit-identical to an uninterrupted run.
+        # Errors out when no resume state exists
 
 Fault tolerance (resilience/): ``scores`` dispatches every config through
 the resilience guard — transient device faults retry with backoff, OOMs
@@ -38,7 +49,11 @@ exits with code 23 (resilience.QUARANTINE_EXIT_CODE) listing the
 quarantined configs. Re-running ``scores`` re-attempts exactly those
 configs (they are absent from the pickle, so the per-config resume picks
 them up). ``F16_FAULT_INJECT=<config>:<attempt>:<class>[;...]`` injects
-deterministic faults for drills (see PROFILE.md "Fault tolerance").
+deterministic faults for drills; the process classes
+``<config>:<fold>:sigkill|sigterm`` kill the process at that fold's
+journal-append point for the chaos drill (tools/chaos_drill.py,
+resilience/supervisor.py; see PROFILE.md "Fault tolerance" and "Crash
+tolerance").
 
 Unknown/missing verbs raise ValueError like the reference.
 """
@@ -91,6 +106,40 @@ def main(argv=None):
                 kw["fused"] = True
             else:
                 raise ValueError(f"Unrecognized scores option {a!r}")
+        write_scores(**kw)
+    elif command == "resume":
+        # Preemption recovery (ISSUE 11): the same sweep as `scores`,
+        # but it REQUIRES on-disk resume state — a write-ahead journal
+        # (<scores.pkl>.journal) and/or a partial scores pickle — so a
+        # typo'd invocation can never silently start from scratch. The
+        # journal replay summary prints before the sweep continues.
+        import os
+
+        from flake16_framework_tpu.constants import (
+            LOPO_SCORES_FILE, SCORES_FILE,
+        )
+        from flake16_framework_tpu.pipeline import write_scores
+        from flake16_framework_tpu.resilience import journal as rjournal
+
+        kw = {}
+        for a in args:
+            if a == "lopo":
+                kw["cv"] = "lopo"
+            elif a.startswith("profile="):
+                kw["profile_dir"] = a.split("=", 1)[1]
+            elif a.startswith("dispatch="):
+                kw["dispatch_trees"] = int(a.split("=", 1)[1]) or None
+            elif a == "fused":
+                kw["fused"] = True
+            else:
+                raise ValueError(f"Unrecognized resume option {a!r}")
+        out_file = (LOPO_SCORES_FILE if kw.get("cv") == "lopo"
+                    else SCORES_FILE)
+        jpath = rjournal.journal_path(out_file)
+        if not (os.path.exists(jpath) or os.path.exists(out_file)):
+            raise ValueError(
+                f"resume: no resume state — neither {jpath} nor "
+                f"{out_file} exists (run `scores` for a fresh sweep)")
         write_scores(**kw)
     elif command == "shap":
         from flake16_framework_tpu.pipeline import write_shap

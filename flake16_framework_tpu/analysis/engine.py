@@ -284,7 +284,9 @@ def save_baseline(path, findings):
         "schema": BASELINE_SCHEMA,
         "fingerprints": sorted(f.fingerprint for f in findings),
     }
-    with open(path, "w") as fd:
+    from flake16_framework_tpu.utils.atomic import atomic_write
+
+    with atomic_write(path, "w") as fd:
         json.dump(obj, fd, indent=1)
         fd.write("\n")
     return obj

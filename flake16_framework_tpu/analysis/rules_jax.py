@@ -19,7 +19,15 @@ not compute, dominate per-config cost):
   transfers in the scoring service's request path (serve/batcher.py and
   serve/queue.py by location, plus any function decorated with
   ``serve.hot_path``) stall the microbatch pipeline — the one sanctioned
-  crossing per microbatch carries an inline ``f16lint: disable=J601``.
+  crossing per microbatch carries an inline ``f16lint: disable=J601``;
+- durable-artifact write hygiene (J701, ISSUE 11): a bare write-mode
+  ``open(..., "w"/"wb")`` tears the artifact when a preemption SIGKILL
+  lands mid-write — durable writes go through ``utils.atomic_write``
+  (tmp + fsync + rename). Append mode is exempt (the O_APPEND JSONL
+  sink's whole-line semantics are the sanctioned crash contract), as
+  are the two modules that ARE the durability layer
+  (utils/atomic.py, resilience/journal.py); standalone plugins that
+  cannot import the package carry inline disables.
 
 Reachability is a module-local static approximation: a function is
 *jit-reachable* when it is decorated with ``jax.jit`` (bare or via
@@ -75,6 +83,10 @@ RULES = {r.id: r for r in (
              "blocking device->host transfer in serve hot-path scope —"
              " stalls the microbatch pipeline; transfers belong at the"
              " batch boundary (one amortized crossing per microbatch)"),
+    RuleInfo("J701", WARNING,
+             "write-mode open() outside utils.atomic_write — a crash or"
+             " preemption mid-write tears the durable artifact; use"
+             " atomic_write (tmp + fsync + rename)"),
 )}
 
 # Call roots whose results are traced arrays (after alias resolution).
@@ -111,6 +123,11 @@ _HOT_BLOCKING = {"jax.block_until_ready", "jax.device_get",
                  "numpy.asarray", "numpy.array"}
 # Modules that are hot-path scope by location (repo-relative posix).
 _HOT_MODULES = ("batcher.py", "queue.py")
+
+# J701: the durability layer itself — raw fd control (fsync'd appends,
+# tmp-file plumbing) is its job, so write-mode open() is sanctioned here
+# and nowhere else.
+_ATOMIC_EXEMPT = ("utils/atomic.py", "resilience/journal.py")
 
 
 def _import_aliases(tree):
@@ -388,6 +405,25 @@ def check_module(mod):
             if isinstance(fnode, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     and hot_decorated(fnode):
                 scan_hot(fnode, f"@hot_path function {fnode.name!r}")
+
+    # -- J701: durable writes bypassing utils.atomic_write --------------
+    if not mod.path.endswith(_ATOMIC_EXEMPT):
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _dotted(node.func, aliases) not in ("open", "io.open"):
+                continue
+            mode = node.args[1] if len(node.args) >= 2 else None
+            for kw in node.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if isinstance(mode, ast.Constant) \
+                    and isinstance(mode.value, str) \
+                    and ("w" in mode.value or "x" in mode.value):
+                emit("J701", node,
+                     f"open(..., {mode.value!r}) writes a durable "
+                     "artifact without tmp+fsync+rename; wrap it in "
+                     "utils.atomic_write")
 
     # -- jit-reachable-only rules --------------------------------------
     for fn in reach.reachable:

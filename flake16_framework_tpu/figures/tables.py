@@ -46,7 +46,9 @@ def cell_shap(cell):
 def render_table(path, sections, *, rowcol=True, cellfn=cell_default):
     """sections: list of row-lists; a \\midrule separates sections; even rows
     (1-based within the table) get a gray rowcolor when ``rowcol``."""
-    with open(path, "w") as fd:
+    from flake16_framework_tpu.utils.atomic import atomic_write
+
+    with atomic_write(path, "w") as fd:
         for s, rows in enumerate(sections):
             if s:
                 fd.write("\\midrule\n")
@@ -90,7 +92,9 @@ def req_runs_coords(req_runs):
 
 
 def render_req_runs_plot(path, req_runs_nod, req_runs_od):
-    with open(path, "w") as fd:
+    from flake16_framework_tpu.utils.atomic import atomic_write
+
+    with atomic_write(path, "w") as fd:
         fd.write(
             f"\\addplot[mark=x,only marks] coordinates "
             f"{{{req_runs_coords(req_runs_nod)}}};\n"

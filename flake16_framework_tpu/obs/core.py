@@ -356,10 +356,11 @@ def _write_manifest_base(state):
 
 
 def _dump_manifest(state, manifest):
+    from flake16_framework_tpu.utils.atomic import atomic_write
+
     path = os.path.join(state.dir, schema.MANIFEST_FILE)
-    with open(path + ".tmp", "w") as fd:
+    with atomic_write(path, "w") as fd:
         json.dump(manifest, fd, indent=1, default=str)
-    os.replace(path + ".tmp", path)
 
 
 def manifest_update(**fields):

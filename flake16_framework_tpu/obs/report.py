@@ -72,6 +72,7 @@ def summarize(manifest, events):
     gauges = {}
     heartbeats = {"n": 0, "last_ts": None}
     faults = {"n": 0, "by_class": {}, "by_action": {}, "quarantined": []}
+    lifecycle = {"journal": {}, "drain": {}, "restarts": 0}
     ts_all = [e["ts"] for e in events if isinstance(e.get("ts"), (int, float))]
     for ev in events:
         kind = ev.get("kind")
@@ -102,6 +103,14 @@ def summarize(manifest, events):
             faults["by_action"][act] = faults["by_action"].get(act, 0) + 1
             if act == "quarantine":
                 faults["quarantined"].append(ev.get("config", "?"))
+        elif kind == "journal":
+            act = ev.get("action", "?")
+            lifecycle["journal"][act] = lifecycle["journal"].get(act, 0) + 1
+        elif kind == "drain":
+            ph = ev.get("phase", "?")
+            lifecycle["drain"][ph] = lifecycle["drain"].get(ph, 0) + 1
+        elif kind == "restart":
+            lifecycle["restarts"] += 1
 
     started = manifest.get("started_ts")
     t0 = started if isinstance(started, (int, float)) else (
@@ -141,6 +150,7 @@ def summarize(manifest, events):
         "gauges": gauges,
         "faults": faults,
         "heartbeats": heartbeats,
+        "lifecycle": lifecycle,
         "n_events": len(events),
     }
 
@@ -361,6 +371,20 @@ def render(report):
         if faults.get("quarantined"):
             out.append("  quarantined: "
                        + ", ".join(str(c) for c in faults["quarantined"]))
+        out.append("")
+
+    life = report.get("lifecycle") or {}
+    if life.get("restarts") or life.get("journal") or life.get("drain"):
+        parts = []
+        if life.get("journal"):
+            parts.append("journal " + ", ".join(
+                f"{k}={v}" for k, v in sorted(life["journal"].items())))
+        if life.get("drain"):
+            parts.append("drain " + ", ".join(
+                f"{k}={v}" for k, v in sorted(life["drain"].items())))
+        if life.get("restarts"):
+            parts.append(f"restarts={life['restarts']}")
+        out.append("lifecycle: " + "; ".join(parts))
         out.append("")
 
     hb = report["heartbeats"]

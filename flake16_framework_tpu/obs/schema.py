@@ -64,6 +64,20 @@ EVENT_FIELDS = {
     # ``lower_s``, ``cache_hits``/``cache_misses`` (persistent
     # compilation-cache events observed during this compile).
     "cost": {"span": str, "flops": _NUM, "bytes": _NUM, "compile_s": _NUM},
+    # Write-ahead journal lifecycle (resilience/journal.py): ``action`` is
+    # replay | truncate | reset | finalize. Replay carries
+    # ``n_configs``/``n_folds`` recovered; truncate carries the byte
+    # ``offset`` of the torn tail; finalize carries ``n_appends`` and the
+    # accumulated ``append_wall_s`` (the steady-state overhead bound).
+    "journal": {"action": str},
+    # Serve graceful-drain state machine (serve/service.py drain):
+    # ``phase`` is begin | complete | abort. Complete/abort carry the
+    # accounting fields ``completed``/``rejected``/``aborted``.
+    "drain": {"phase": str},
+    # Supervisor child restart (resilience/supervisor.py): ``attempt`` is
+    # the 1-based restart number; extra fields ``rc`` (the death the
+    # restart answers, negative = killed by that signal) and ``budget``.
+    "restart": {"attempt": int},
 }
 
 MANIFEST_FIELDS = {

@@ -8,9 +8,11 @@ Spans become ``X`` (complete) duration events laid out on one lane per
 emitting thread — span events carry ``tid`` since this PR; older logs
 fall back to one lane per span-name family (``scores``, ``shap``, ...).
 Counters and gauges become ``C`` counter tracks, and the point-like kinds
-(fault, heartbeat, profile, stage, cost) become ``i`` instants whose args
-carry the full event, so a 216-config sweep reads as a timeline in
-chrome://tracing or https://ui.perfetto.dev instead of a JSONL scroll.
+(fault, heartbeat, profile, stage, cost, journal, drain, restart) become
+``i`` instants whose args carry the full event, so a 216-config sweep —
+preemptions, journal replays and drains included — reads as a timeline
+in chrome://tracing or https://ui.perfetto.dev instead of a JSONL
+scroll.
 
 ``summarize_device_trace`` is the trace-summarization half of
 tools/hw_trace.py (top device ops by total duration from a perfetto
@@ -31,7 +33,8 @@ from flake16_framework_tpu.obs import report, schema
 
 # Kinds rendered as point events; everything else schema-known is handled
 # explicitly below.
-_INSTANT_KINDS = ("fault", "heartbeat", "profile", "stage", "cost")
+_INSTANT_KINDS = ("fault", "heartbeat", "profile", "stage", "cost",
+                  "journal", "drain", "restart")
 
 _PID = 1  # single-process runs: one chrome "process" per run
 
@@ -112,7 +115,9 @@ def write_trace(run_dir, out_path=None):
     manifest, events = report.load_run(run_dir)
     trace = chrome_trace(manifest, events)
     out_path = out_path or os.path.join(run_dir, "trace.json")
-    with open(out_path, "w") as fd:
+    from flake16_framework_tpu.utils.atomic import atomic_write
+
+    with atomic_write(out_path, "w") as fd:
         json.dump(trace, fd)
     return out_path, trace
 

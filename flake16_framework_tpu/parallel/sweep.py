@@ -142,6 +142,21 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
         )(fold_keys, train_mask)
         return forest, xp, y
 
+    def fit_folds_one(x, y_raw, flaky_label, prep_code, bal_code, fold_keys,
+                      train_mask):
+        """``fit_one`` for an EXPLICIT fold subset: ``fold_keys`` [m, 2]
+        rows of split(key, n_folds) and the matching train-mask rows.
+        Same vmap body, so each fold's forest is bit-identical to the row
+        the full fit would have produced — the journal-resume entry point
+        (resilience/journal.py): the host selects exactly the folds the
+        journal lacks. Each distinct m is one extra compile (resume-path
+        only; the steady-state sweep never calls this)."""
+        y, xp, edges = _prep(x, y_raw, flaky_label, prep_code)
+        forest = jax.vmap(
+            lambda fk, wt: _fold_fit(xp, y, bal_code, edges, fk, wt, None)
+        )(fold_keys, train_mask)
+        return forest, xp, y
+
     def tree_keys_one(key):
         """The full [n_folds, n_trees, 2] per-tree key table of ``fit_one``
         (fold key -> (kb, kf) -> split(kf, n_trees)); slices of it drive
@@ -184,6 +199,20 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
             y, preds, test_mask, project_ids, n_projects
         )
 
+    def score_folds_one(forest, xp, y, test_mask, project_ids):
+        """Per-FOLD confusion counts [m, P, 3] (``score_one`` keeps the
+        fold axis instead of flattening it into one segment_sum). Counts
+        are int32 and fold-additive, so summing over axis 0 reproduces
+        ``score_one``'s totals bit-exactly — which is what makes the fold
+        the journal's restart quantum."""
+        def per_fold(f, tm):
+            preds = trees.predict(f, xp)
+            return confusion_by_project(
+                y, preds, tm, project_ids, n_projects
+            )
+
+        return jax.vmap(per_fold)(forest, test_mask)
+
     def run_all_one(x, y_raw, flaky_label, prep_code, bal_code, key,
                     train_mask, test_mask, project_ids):
         """The whole per-config CV pipeline — preprocess, resample, fit,
@@ -201,7 +230,7 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
         return score_one(forest, xp, y, test_mask, project_ids)
 
     return (fit_one, score_one, prep_resample_one, fit_trees_chunk,
-            tree_keys_one, run_all_one)
+            tree_keys_one, run_all_one, fit_folds_one, score_folds_one)
 
 
 def _fit_cost_fields(spec, *, n, n_feat, cap, n_folds, grower):
@@ -239,7 +268,10 @@ def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
     (n, n_feat, spec) so each family compiles exactly once.
 
     Returns (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys,
-    cv_all); cv_prep/cv_fit_chunk/cv_tree_keys drive the dispatch-chunked
+    cv_all, cv_fit_folds, cv_score_folds); the last two are the
+    journal-resume pair (explicit fold subsets / per-fold counts — see
+    _make_config_fns). cv_prep/cv_fit_chunk/cv_tree_keys drive the
+    dispatch-chunked
     fit (SweepEngine.run_config with ``dispatch_trees``): one prep+resample
     dispatch, then one bounded fit dispatch per tree-key slice (compiled
     once per chunk width). ``cv_all`` is the single-dispatch fusion of
@@ -257,8 +289,10 @@ def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
     fit_fields = _fit_cost_fields(spec, n=n, n_feat=n_feat, cap=cap,
                                   n_folds=n_folds, grower=grower)
     names = ("scores.fit", "scores.score", "scores.prep",
-             "scores.fit_chunk", "scores.tree_keys", "scores.config")
-    carries_fit = {"scores.fit", "scores.fit_chunk", "scores.config"}
+             "scores.fit_chunk", "scores.tree_keys", "scores.config",
+             "scores.fit_folds", "scores.score_folds")
+    carries_fit = {"scores.fit", "scores.fit_chunk", "scores.config",
+                   "scores.fit_folds"}
     return tuple(
         costs.instrument(jax.jit(f), nm,
                          cost_fields=fit_fields if nm in carries_fit
@@ -272,7 +306,9 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
     production sweep path (the reference forks a process per config,
     experiment.py:493-498; here a batch of configs is one SPMD program).
 
-    Returns (fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b, all_b):
+    Returns (fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b, all_b,
+    score_folds_b — score_b keeping the fold axis, for journal fold
+    records on the mesh path):
       fit_b(x, y_raw, fls [B], preps [B], bals [B], keys [B,2],
             train_masks [B,folds,N]) -> (forest [B,folds,...], xp [B,N,F'],
             y [B,N]) — all sharded over "config", left on device.
@@ -290,10 +326,11 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
     mesh "config" axis size; within a shard, configs ride a vmap axis.
     """
     (fit_one, score_one, prep_resample_one, fit_trees_chunk,
-     tree_keys_one, run_all_one) = _make_config_fns(
-        spec, n=n, n_projects=n_projects, max_depth=max_depth,
-        n_folds=n_folds, tree_chunk=tree_chunk, grower=grower,
-    )
+     tree_keys_one, run_all_one, _fit_folds_one, score_folds_one) = \
+        _make_config_fns(
+            spec, n=n, n_projects=n_projects, max_depth=max_depth,
+            n_folds=n_folds, tree_chunk=tree_chunk, grower=grower,
+        )
 
     def fit_batch(x, y_raw, fls, preps, bals, keys, train_masks):
         return jax.vmap(
@@ -318,6 +355,15 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
     def score_batch(forest, xp, y, test_masks, project_ids):
         return jax.vmap(
             lambda f, xpi, yi, tem: score_one(f, xpi, yi, tem, project_ids)
+        )(forest, xp, y, test_masks)
+
+    def score_folds_batch(forest, xp, y, test_masks, project_ids):
+        # Per-fold counts [B, folds, P, 3] — the journal's fold records on
+        # the mesh path; summing axis 1 reproduces score_batch bit-exactly
+        # (int32 fold additivity, see score_folds_one).
+        return jax.vmap(
+            lambda f, xpi, yi, tem: score_folds_one(
+                f, xpi, yi, tem, project_ids)
         )(forest, xp, y, test_masks)
 
     def all_batch(x, y_raw, fls, preps, bals, keys, train_masks, test_masks,
@@ -365,10 +411,14 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
                        "scores.tree_keys_batch")
     score_b = smap(score_batch, (forest_specs, pspec, pspec, pspec, P()),
                    pspec, "scores.score_batch")
+    score_folds_b = smap(score_folds_batch,
+                         (forest_specs, pspec, pspec, pspec, P()),
+                         pspec, "scores.score_folds_batch")
     all_b = smap(all_batch, (P(), P(), pspec, pspec, pspec, pspec, pspec,
                              pspec, P()), pspec, "scores.config_batch",
                  cost_fields=fit_fields)
-    return fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b, all_b
+    return (fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b, all_b,
+            score_folds_b)
 
 
 def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
@@ -495,7 +545,7 @@ class SweepEngine:
                  project_ids, *, mesh=None, max_depth=48, seed=0,
                  n_folds=None, tree_overrides=None, cv="stratified",
                  dispatch_trees=None, dispatch_folds=None, grower=None,
-                 fused=False):
+                 fused=False, journal=None):
         self.features = np.asarray(features, dtype=np.float32)
         self.labels_raw = np.asarray(labels_raw, dtype=np.int32)
         self.projects = projects
@@ -530,6 +580,13 @@ class SweepEngine:
         # to the staged path, which stays the attribution instrument.
         self.fused = fused
         self.fused_configs = set()
+        # Write-ahead journal (resilience/journal.py, ISSUE 11): when
+        # attached, every completed fold's counts are fsync'd before the
+        # sweep moves on, and run_config resumes partially-journaled
+        # configs by fitting ONLY their missing folds (identical fold
+        # keys, so the combined counts are bit-identical to an
+        # uninterrupted run). None = pre-ISSUE-11 behavior exactly.
+        self.journal = journal
         # tests shrink ensembles: {"Random Forest": 10, ...}
         self.tree_overrides = tree_overrides or {}
         # Configs whose T_TRAIN/T_TEST are batch-amortized (every config
@@ -618,15 +675,14 @@ class SweepEngine:
         ``timings``: optional dict filled with per-stage walls (extra device
         syncs in timed mode only — see _chunked_fit)."""
         fl_name, fs_name, prep_name, bal_name, model_name = config_keys
-        (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys, cv_all), \
+        (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys, cv_all,
+         cv_fit_folds, cv_score_folds), \
             cols = self._get_fns(fs_name, model_name)
 
         x = jnp.asarray(self.features[:, cols])
         train_mask, test_mask = self._masks[fl_name]
-        key = jax.random.fold_in(
-            jax.random.PRNGKey(self.seed),
-            list(cfg.iter_config_keys()).index(tuple(config_keys)),
-        )
+        cfg_index = list(cfg.iter_config_keys()).index(tuple(config_keys))
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), cfg_index)
         fit_args = (
             x, jnp.asarray(self.labels_raw),
             jnp.int32(cfg.FLAKY_TYPES[fl_name]),
@@ -653,12 +709,47 @@ class SweepEngine:
             scores, scores_total = format_scores(
                 counts, self.project_names, self.projects
             )
-            return [wall / self.n_folds, 0.0, scores, scores_total]
+            result = [wall / self.n_folds, 0.0, scores, scores_total]
+            if self.journal is not None:
+                # Fused mode returns only the config total in one
+                # dispatch, so its journal granularity is the config (the
+                # fold-granular path is the staged one).
+                self.journal.record_config(config_keys, result)
+            return result
+
+        # Journal resume state: folds already journaled for this config
+        # (with matching rng keys) are trusted and not refit; the fit
+        # below covers exactly the missing ones.
+        journal = self.journal
+        done_counts = {}
+        fold_keys_host = None
+        if journal is not None:
+            fold_keys_host = np.asarray(jax.random.split(key, self.n_folds))
+            for f, (kb, cnt) in journal.partial_folds(config_keys).items():
+                if 0 <= int(f) < self.n_folds and \
+                        bytes(kb) == fold_keys_host[int(f)].tobytes():
+                    done_counts[int(f)] = np.asarray(cnt)
+        missing = [f for f in range(self.n_folds) if f not in done_counts]
 
         with obs.span("scores.fit", key=(*family, "staged"), stage="fit",
                       config="/".join(config_keys)) as fit_sp:
             t0 = time.time()
-            if dc is not None or df is not None:
+            forest = xp = y = None
+            if not missing:
+                # Every fold's counts were journaled; only the config
+                # record was lost. Nothing to fit.
+                pass
+            elif journal is not None and len(missing) < self.n_folds:
+                forest, xp, y = cv_fit_folds(
+                    x, jnp.asarray(self.labels_raw),
+                    jnp.int32(cfg.FLAKY_TYPES[fl_name]),
+                    jnp.int32(cfg.PREPROCESSINGS[prep_name]),
+                    jnp.int32(cfg.BALANCINGS[bal_name]),
+                    jnp.asarray(fold_keys_host[missing]),
+                    jnp.asarray(np.asarray(train_mask)[missing]),
+                )
+                jax.block_until_ready(forest)
+            elif dc is not None or df is not None:
                 # Telemetry-on runs get the sub-stage split (prep/resample
                 # vs tree growth) even without an explicit timings dict —
                 # the documented extra syncs of timed mode apply
@@ -682,26 +773,50 @@ class SweepEngine:
         with obs.span("scores.score", key=(*family, "staged"),
                       stage="predict", config="/".join(config_keys)):
             t0 = time.time()
-            counts = cv_score(
-                forest, xp, y, jnp.asarray(test_mask),
-                jnp.asarray(self.project_ids),
-            )
-            if timings is not None:
-                jax.block_until_ready(counts)
-                timings["score_s"] = round(time.time() - t0, 4)
-                t1 = time.time()
-                counts = np.asarray(counts)
-                timings["counts_to_host_s"] = round(time.time() - t1, 4)
+            if journal is None:
+                counts = cv_score(
+                    forest, xp, y, jnp.asarray(test_mask),
+                    jnp.asarray(self.project_ids),
+                )
+                if timings is not None:
+                    jax.block_until_ready(counts)
+                    timings["score_s"] = round(time.time() - t0, 4)
+                    t1 = time.time()
+                    counts = np.asarray(counts)
+                    timings["counts_to_host_s"] = round(time.time() - t1, 4)
+                else:
+                    counts = np.asarray(counts)
             else:
-                counts = np.asarray(counts)
+                # Fold-granular scoring: per-fold [m, P, 3] counts reach
+                # the host, each fold is journaled (fsync'd) the moment it
+                # lands, and the config total is the int32 fold sum — the
+                # same segment_sums score_one folds together, so the total
+                # is bit-identical to the journal-off path.
+                if missing:
+                    counts_f = np.asarray(cv_score_folds(
+                        forest, xp, y,
+                        jnp.asarray(np.asarray(test_mask)[missing]),
+                        jnp.asarray(self.project_ids),
+                    ))
+                    for i, f in enumerate(missing):
+                        journal.record_fold(
+                            config_keys, f, fold_keys_host[f].tobytes(),
+                            counts_f[i], config_index=cfg_index)
+                        done_counts[f] = counts_f[i]
+                counts = np.sum(
+                    np.stack([done_counts[f]
+                              for f in range(self.n_folds)]), axis=0)
             t_test = time.time() - t0
         self._count_done(1, n_trees)
 
         scores, scores_total = format_scores(
             counts, self.project_names, self.projects
         )
-        return [t_train / self.n_folds, t_test / self.n_folds, scores,
-                scores_total]
+        result = [t_train / self.n_folds, t_test / self.n_folds, scores,
+                  scores_total]
+        if journal is not None:
+            journal.record_config(config_keys, result)
+        return result
 
     def _count_done(self, n_configs, n_trees):
         """Throughput counters after a config (or batch) completes —
@@ -741,7 +856,8 @@ class SweepEngine:
         fs_name, model_name = config_batch[0][1], config_batch[0][4]
         assert all(k[1] == fs_name and k[4] == model_name
                    for k in config_batch)
-        (fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b, all_b), cols = \
+        (fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b, all_b,
+         score_folds_b), cols = \
             self._get_sharded_fns(fs_name, model_name)
 
         d = self.mesh.devices.size
@@ -791,6 +907,9 @@ class SweepEngine:
             self.fused_configs.update(tuple(k) for k in config_batch)
             self.amortized_configs.update(tuple(k) for k in config_batch)
             self._count_done(len(config_batch), n_trees)
+            if self.journal is not None:
+                for k, res in zip(config_batch, out):
+                    self.journal.record_config(k, res)
             return out
 
         with obs.span("scores.fit_batch", key=(*family, "staged", b),
@@ -818,9 +937,26 @@ class SweepEngine:
                       stage="predict", batch=len(config_batch),
                       configs=configs_field):
             t0 = time.time()
-            counts = score_b(forest, xp, y, jnp.asarray(tems),
-                             jnp.asarray(self.project_ids))
-            counts = np.asarray(counts)
+            if self.journal is None:
+                counts = score_b(forest, xp, y, jnp.asarray(tems),
+                                 jnp.asarray(self.project_ids))
+                counts = np.asarray(counts)
+            else:
+                # Fold-granular counts on the mesh path too: [B, folds,
+                # P, 3] to host, every real config's folds journaled,
+                # config totals as the int32 fold sum (bit-identical to
+                # score_b — see score_folds_one).
+                counts_f = np.asarray(score_folds_b(
+                    forest, xp, y, jnp.asarray(tems),
+                    jnp.asarray(self.project_ids)))
+                counts = counts_f.sum(axis=1)
+                for i, k in enumerate(config_batch):
+                    fkh = np.asarray(jax.random.split(
+                        jnp.asarray(keys[i]), self.n_folds))
+                    for f in range(self.n_folds):
+                        self.journal.record_fold(
+                            k, f, fkh[f].tobytes(), counts_f[i, f],
+                            config_index=all_keys.index(tuple(k)))
             t_test = (time.time() - t0) / len(config_batch)
         self._count_done(len(config_batch), n_trees)
 
@@ -832,6 +968,9 @@ class SweepEngine:
             out.append([t_train / self.n_folds, t_test / self.n_folds,
                         scores, scores_total])
         self.amortized_configs.update(tuple(k) for k in config_batch)
+        if self.journal is not None:
+            for k, res in zip(config_batch, out):
+                self.journal.record_config(k, res)
         return out
 
     def run_grid(self, config_list=None, ledger=None, progress=None,
@@ -900,7 +1039,23 @@ class SweepEngine:
             return scores
 
         done = 0
-        for batch in iter_family_batches(todo, b):
+        rest = todo
+        if self.journal is not None:
+            # Partially-journaled configs resume on the per-config path
+            # (fold-subset fit — run_config); only fresh configs batch
+            # over the mesh.
+            partial = [k for k in todo if self.journal.partial_folds(k)]
+            if partial:
+                rest = [k for k in todo
+                        if not self.journal.partial_folds(k)]
+                for keys in partial:
+                    res = run_guarded(keys)
+                    if res is not None:
+                        scores[keys] = res
+                    done += 1
+                    if progress is not None:
+                        progress(done, len(todo), keys, scores)
+        for batch in iter_family_batches(rest, b):
             if len(batch) > 1:
                 def batch_thunk(batch=batch):
                     with rladder.device_context():

@@ -30,7 +30,10 @@ from flake16_framework_tpu.ops.preprocess import fit_preprocess, transform
 from flake16_framework_tpu.ops.resample import resample
 from flake16_framework_tpu.parallel.sweep import SweepEngine
 from flake16_framework_tpu.resilience import faults
+from flake16_framework_tpu.resilience import inject as rinject
+from flake16_framework_tpu.resilience import journal as rjournal
 from flake16_framework_tpu.resilience import quarantine as rquarantine
+from flake16_framework_tpu.utils.atomic import atomic_write
 
 
 def _load_arrays(tests_file):
@@ -38,12 +41,19 @@ def _load_arrays(tests_file):
 
 
 def _load_ledger(out_file, warn_out=sys.stderr):
-    """Crash-consistent resume: load the checkpoint ledger, tolerating a
-    truncated/corrupt partial pickle (a kill mid-_dump leaves only the
-    .tmp torn, but a pre-ISSUE-3 artifact or a torn filesystem may still
-    hand us garbage). A bad ledger WARNS and restarts the affected
-    configs rather than aborting the sweep; entries that do not carry the
-    reference 4-element value schema are dropped individually."""
+    """Legacy (pre-journal) resume source: load the pickle checkpoint
+    ledger, tolerating a truncated/corrupt partial pickle (a kill
+    mid-_dump leaves only the .tmp torn, but a pre-ISSUE-3 artifact or a
+    torn filesystem may still hand us garbage). A bad ledger WARNS and
+    restarts the affected configs rather than aborting the sweep; entries
+    that do not carry the reference 4-element value schema are dropped
+    individually.
+
+    ISSUE 11 layers the write-ahead journal (resilience/journal.py) on
+    top: write_scores merges this ledger with the journal's replayed
+    config records (journal wins — it is fsync'd per fold, the pickle
+    only every ``checkpoint_every`` configs), and partially-journaled
+    configs resume at FOLD granularity inside SweepEngine.run_config."""
     if not os.path.exists(out_file):
         return {}
     try:
@@ -77,13 +87,47 @@ def _load_ledger(out_file, warn_out=sys.stderr):
     return ledger
 
 
+def _journal_fingerprint(engine, *, cv, max_depth, tree_overrides):
+    """The run identity a journal must match to be replayed: everything
+    that changes fold keys, fold membership, or per-fold counts. A
+    mismatch (different seed, data, cv scheme, grower tier, ...) makes
+    journaled folds silently wrong, so SweepJournal.open discards the
+    whole journal on disagreement."""
+    import zlib
+
+    return {
+        "schema": rjournal.SCHEMA,
+        "seed": engine.seed,
+        "cv": cv,
+        "n_folds": engine.n_folds,
+        "max_depth": max_depth,
+        "grower": engine.grower or os.environ.get("F16_ENSEMBLE_GROWER",
+                                                  "hist"),
+        "tree_overrides": sorted((tree_overrides or {}).items()),
+        "data": [list(engine.features.shape),
+                 zlib.crc32(engine.labels_raw.tobytes()),
+                 zlib.crc32(engine.features.tobytes())],
+    }
+
+
 def write_scores(tests_file=TESTS_FILE, out_file=None, *,
                  max_depth=48, tree_overrides=None, configs=None,
                  checkpoint_every=12, progress_out=sys.stdout,
                  cv="stratified", mesh=None, profile_dir=None,
-                 dispatch_trees=None, dispatch_folds=None, fused=False):
+                 dispatch_trees=None, dispatch_folds=None, fused=False,
+                 journal=True):
     """Run the (216-config x 10-fold) sweep and pickle the reference-schema
     scores dict. Resumes from an existing partial ``out_file``.
+
+    Crash tolerance (ISSUE 11): with ``journal=True`` (default) a
+    write-ahead journal rides beside the pickle at
+    ``<out_file>.journal`` — fsync'd, checksummed records at FOLD
+    granularity. A killed run resumes exactly its unfinished
+    (config, fold) pairs with identical rng keys, so the final pickle is
+    bit-identical (scores content) to an uninterrupted run; the journal
+    is deleted once the final pickle is durably on disk. A second
+    concurrent resumer fails fast with ``resilience.JournalLocked``
+    (stale locks from dead pids are taken over).
 
     ``cv="lopo"`` switches to the 26-project leave-one-project-out CV
     (BASELINE.json north star); its default output is ``scores-lopo.pkl`` —
@@ -109,6 +153,25 @@ def write_scores(tests_file=TESTS_FILE, out_file=None, *,
 
     ledger = _load_ledger(out_file)
 
+    jr = None
+    if journal:
+        fp = _journal_fingerprint(engine, cv=cv, max_depth=max_depth,
+                                  tree_overrides=tree_overrides)
+        # Fails fast with JournalLocked when a live second resumer holds
+        # the lock; a dead holder's lock is taken over (journal.py).
+        jr = rjournal.SweepJournal.open(
+            rjournal.journal_path(out_file), fp,
+            plan=rinject.plan_from_env())
+        if jr.ledger or jr.partial:
+            progress_out.write(
+                f"journal: replayed {len(jr.ledger)} completed config(s) "
+                f"and {sum(len(v) for v in jr.partial.values())} partial "
+                f"fold(s) from {rjournal.journal_path(out_file)}\n")
+        # Journal beats pickle where they disagree: the journal is
+        # fsync'd per fold, the pickle only every checkpoint_every.
+        ledger.update(jr.ledger)
+        engine.journal = jr
+
     t0 = time.time()
 
     def progress(i, total, keys, live_scores):
@@ -130,13 +193,26 @@ def write_scores(tests_file=TESTS_FILE, out_file=None, *,
     # profile_dir is a no-op); telemetry spans/counters ride the same run.
     obs.manifest_update(verb="scores", cv=cv, out_file=str(out_file),
                         fused=fused)
-    with obs.profiler_trace(profile_dir):
-        with obs.span("scores.run_grid", cv=cv):
-            scores_all = engine.run_grid(configs, ledger=ledger,
-                                         progress=progress)
+    try:
+        with obs.profiler_trace(profile_dir):
+            with obs.span("scores.run_grid", cv=cv):
+                scores_all = engine.run_grid(configs, ledger=ledger,
+                                             progress=progress)
+    except BaseException:
+        # Leave the journal ON DISK (it is the resume state) but close
+        # the fd and release the pid lock so an in-process retry — or a
+        # supervised restart that outlives us — can take over cleanly.
+        if jr is not None:
+            jr.close(remove=False)
+        raise
     _dump(scores_all, out_file)
     _write_timing_meta(out_file, engine.amortized_configs,
                        engine.fused_configs)
+    if jr is not None:
+        # The durable pickle now supersedes the journal: drop it (and the
+        # lock). Quarantined configs are absent from BOTH, so the next
+        # run still re-attempts exactly them.
+        jr.finalize()
     obs.emit_memory_gauges()
     # Quarantine accounting AFTER every artifact is on disk: the sidecar
     # records this run's quarantined configs (fault class + attempt
@@ -182,7 +258,7 @@ def _write_timing_meta(out_file, amortized_configs, fused_configs=()):
         known_fused = {tuple(k) for k in prev.get("fused_combined", [])}
     merged = sorted(known | {tuple(k) for k in amortized_configs})
     merged_fused = sorted(known_fused | {tuple(k) for k in fused_configs})
-    with open(meta_file + ".tmp", "w") as fd:
+    with atomic_write(meta_file, "w") as fd:
         json.dump({
             "schema": "flake16-timing-meta-v1",
             "note": ("configs under batch_amortized have batch-amortized "
@@ -193,14 +269,11 @@ def _write_timing_meta(out_file, amortized_configs, fused_configs=()):
             "batch_amortized": [list(k) for k in merged],
             "fused_combined": [list(k) for k in merged_fused],
         }, fd, indent=1)
-    os.replace(meta_file + ".tmp", meta_file)
 
 
 def _dump(obj, path):
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as fd:
+    with atomic_write(path, "wb") as fd:
         pickle.dump(obj, fd)
-    os.replace(tmp, path)
 
 
 @functools.lru_cache(maxsize=None)
@@ -361,7 +434,9 @@ def write_shap(tests_file=TESTS_FILE, out_file=SHAP_FILE, *, max_depth=48,
                             sample_chunk=sample_chunk, impl=impl)
             for keys in cfg.SHAP_CONFIGS
         ]
-    with open(out_file, "wb") as fd:
+    # atomic_write: a kill mid-dump must leave the previous complete
+    # artifact, not a torn pickle (this site was the last bare open()).
+    with atomic_write(out_file, "wb") as fd:
         pickle.dump(values, fd)
     obs.emit_memory_gauges()
     return values

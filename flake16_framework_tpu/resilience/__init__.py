@@ -11,15 +11,19 @@ failure modes that previously aborted whole sweeps (PROFILE.md
 - ladder.py      — the degradation ladder: pallas->xla, halve chunk
                    bounds on oom, CPU fallback on relay-down
 - inject.py      — F16_FAULT_INJECT: deterministic fault injection so
-                   tier-1 exercises every path on CPU
+                   tier-1 exercises every path on CPU (ISSUE 11 adds
+                   process classes sigkill/sigterm for the chaos drill)
 - quarantine.py  — the per-config quarantine sidecar + nonzero exit
+- journal.py     — the write-ahead sweep journal: fold-granular,
+                   fsync'd, checksummed resume state (ISSUE 11)
+- supervisor.py  — restart-budgeted child supervision + chaos mode
 
 No module here imports jax at import time: the relay-down diagnosis must
 run while any jax import would hang at backend init (utils/relay.py).
 """
 
 from flake16_framework_tpu.resilience import (  # noqa: F401
-    faults, inject, ladder, quarantine,
+    faults, inject, journal, ladder, quarantine, supervisor,
 )
 from flake16_framework_tpu.resilience.faults import (  # noqa: F401
     DETERMINISTIC, ENVELOPE_OVERRUN, FAULT_CLASSES, OOM, RELAY_DOWN,
@@ -30,8 +34,14 @@ from flake16_framework_tpu.resilience.guard import (  # noqa: F401
     policy_from_env, relay_is_device_path,
 )
 from flake16_framework_tpu.resilience.inject import (  # noqa: F401
-    InjectedFault, parse_plan, plan_from_env,
+    InjectedFault, parse_plan, plan_from_env, strip_process_entries,
+)
+from flake16_framework_tpu.resilience.journal import (  # noqa: F401
+    JournalLock, JournalLocked, SweepJournal, journal_path,
 )
 from flake16_framework_tpu.resilience.quarantine import (  # noqa: F401
     QUARANTINE_EXIT_CODE, QuarantinedConfigs,
+)
+from flake16_framework_tpu.resilience.supervisor import (  # noqa: F401
+    RestartBudgetExceeded, supervise,
 )

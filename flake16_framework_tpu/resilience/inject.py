@@ -23,13 +23,37 @@ The guard consults the plan BEFORE each dispatch attempt, so an injected
 fault takes the exact classify/retry path a real device fault would.
 With a plan active the sweep runs the per-config path (no mesh batching)
 so config indices address dispatches deterministically.
+
+ISSUE 11 extends the grammar with PROCESS classes — ``sigkill`` and
+``sigterm`` — for the chaos harness (resilience/supervisor.py,
+tools/chaos_drill.py). A process entry reads
+
+    <config>:<fold>:sigkill
+
+where the second field is the 1-based FOLD whose journal append triggers
+the signal: the write-ahead journal (resilience/journal.py) delivers the
+signal to its own process immediately AFTER fsyncing that fold's record,
+which is the deterministic "journal-injected point" the kill drill
+needs (the record is durable, everything after it is lost). Process
+entries are invisible to the dispatch guard — ``check`` skips them, so
+retry/degrade/quarantine semantics are untouched — and the supervisor
+strips them from the child environment on restart so each injected kill
+fires exactly once.
 """
 
 import os
+import signal as _signal
 
 from flake16_framework_tpu.resilience import faults
 
 ENV_VAR = "F16_FAULT_INJECT"
+
+# Process-level classes (chaos harness): delivered as real signals by the
+# journal at fold-append points, not raised as InjectedFault by the guard.
+PROCESS_CLASSES = {
+    "sigkill": _signal.SIGKILL,
+    "sigterm": _signal.SIGTERM,
+}
 
 _CLASS_ALIASES = {
     "transient": faults.TRANSIENT_DEVICE,
@@ -62,13 +86,33 @@ class FaultPlan:
 
     def check(self, config_index, attempt):
         """Raise InjectedFault when the plan schedules a fault for this
-        (config, attempt) dispatch; no-op otherwise."""
+        (config, attempt) dispatch; no-op otherwise. Process entries
+        (sigkill/sigterm) are NOT the guard's to deliver — they belong to
+        the journal's fold-append points — so they are skipped here."""
         for k, j, fc in self.entries:
+            if fc in PROCESS_CLASSES:
+                continue
             if (k is None or k == config_index) and \
                     (j is None or j == attempt):
                 raise InjectedFault(
                     f"injected {fc} fault "
                     f"(config {config_index}, attempt {attempt})", fc)
+
+    def process_entries(self):
+        """The (config_index, fold_1based, class_name) process entries —
+        the chaos-harness subset of the plan."""
+        return tuple((k, j, fc) for k, j, fc in self.entries
+                     if fc in PROCESS_CLASSES)
+
+    def process_signal(self, config_index, fold):
+        """The signal number scheduled for this (config, 1-based fold)
+        journal append, or None. Consulted by SweepJournal.record_fold
+        AFTER the record is fsync'd."""
+        for k, j, fc in self.process_entries():
+            if (k is None or k == config_index) and \
+                    (j is None or j == fold):
+                return PROCESS_CLASSES[fc]
+        return None
 
 
 def parse_plan(spec):
@@ -93,14 +137,34 @@ def parse_plan(spec):
                 f"integer or '*'") from None
         if j is not None and j < 1:
             raise ValueError(
-                f"{ENV_VAR} entry {raw!r}: attempts are 1-based")
-        fc = _CLASS_ALIASES.get(fc_s)
+                f"{ENV_VAR} entry {raw!r}: attempts/folds are 1-based")
+        if fc_s in PROCESS_CLASSES:
+            fc = fc_s
+        else:
+            fc = _CLASS_ALIASES.get(fc_s)
         if fc is None:
             raise ValueError(
                 f"{ENV_VAR} entry {raw!r}: unknown fault class {fc_s!r} "
-                f"(want one of {sorted(set(_CLASS_ALIASES))})")
+                f"(want one of "
+                f"{sorted(set(_CLASS_ALIASES) | set(PROCESS_CLASSES))})")
         entries.append((k, j, fc))
     return FaultPlan(entries)
+
+
+def strip_process_entries(spec):
+    """``spec`` minus its process (sigkill/sigterm) entries — what the
+    supervisor exports to a restarted child so an injected kill fires
+    exactly once. Returns "" when nothing survives."""
+    kept = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = [p.strip() for p in raw.split(":")]
+        if len(parts) == 3 and parts[2] in PROCESS_CLASSES:
+            continue
+        kept.append(raw)
+    return ";".join(kept)
 
 
 def plan_from_env(environ=None):

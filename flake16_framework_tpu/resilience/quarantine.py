@@ -52,7 +52,7 @@ def load_sidecar(path):
 
 
 def save_sidecar(path, entries):
-    """Atomic write (tmp + os.replace, like the pickle it sits beside)."""
+    """Atomic write (utils.atomic_write, like the pickle it sits beside)."""
     doc = {
         "schema": SIDECAR_SCHEMA,
         "note": ("configs quarantined by the resilience layer: each "
@@ -65,9 +65,10 @@ def save_sidecar(path, entries):
             for keys, e in sorted(entries.items())
         ],
     }
-    with open(path + ".tmp", "w") as fd:
+    from flake16_framework_tpu.utils.atomic import atomic_write
+
+    with atomic_write(path, "w") as fd:
         json.dump(doc, fd, indent=1)
-    os.replace(path + ".tmp", path)
 
 
 def update_sidecar(path, quarantined, completed=()):

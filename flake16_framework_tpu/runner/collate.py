@@ -273,6 +273,8 @@ def assemble_tests(projects, n_runs=N_RUNS):
 def write_tests(data_dir=DATA_DIR, out_file=TESTS_FILE,
                 subjects_dir=SUBJECTS_DIR, n_runs=N_RUNS):
     tests = assemble_tests(collate(data_dir, subjects_dir), n_runs=n_runs)
-    with open(out_file, "w") as fd:
+    from flake16_framework_tpu.utils.atomic import atomic_write
+
+    with atomic_write(out_file, "w") as fd:
         json.dump(tests, fd, indent=4)
     return tests

@@ -18,8 +18,12 @@ artifacts of a sweep into a latency-serving layer:
   failover path;
 - ``service``   — ``ScoringService``: the in-process client API plus
   p50/p99 latency and queue-depth emission through the existing
-  telemetry spans/gauges (``report``/``trace`` work unchanged);
-- ``cli``       — the ``serve`` CLI verb.
+  telemetry spans/gauges (``report``/``trace`` work unchanged), and
+  ``drain()`` — the graceful SIGTERM path (ISSUE 11b): admission
+  close, in-flight completion, retriable rejection of unstarted
+  requests, durable-state flush with a deadline that escalates to
+  checkpoint-and-abort;
+- ``cli``       — the ``serve`` CLI verb (``--hold`` = drain drill).
 
 ``hot_path`` marks request-path functions OUTSIDE serve/batcher.py and
 serve/queue.py (which are hot-path scope by location) for f16lint's J601
@@ -37,7 +41,8 @@ def hot_path(fn):
 
 
 from flake16_framework_tpu.serve.queue import (  # noqa: E402,F401
-    RequestQueue, RequestRejected, ScoreRequest, ServeError,
+    RequestQueue, RequestRejected, RetriableRejection, ScoreRequest,
+    ServeError,
 )
 from flake16_framework_tpu.serve.registry import (  # noqa: E402,F401
     ModelRegistry, RegisteredModel, artifact_signature, configs_from_ledger,

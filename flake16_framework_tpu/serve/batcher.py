@@ -60,11 +60,35 @@ class Microbatcher:
             t.start()
 
     def stop(self, timeout=5.0):
-        """Stop collecting; in-flight and handed-off batches drain."""
+        """Stop collecting; in-flight and handed-off batches drain.
+        Returns True when every worker thread exited within ``timeout``
+        (the shared deadline, not per-thread) — the drain path
+        escalates to :meth:`abort_pending` on False."""
         self._stop.set()
+        deadline = time.monotonic() + timeout
+        clean = True
         for t in self._threads:
-            t.join(timeout)
+            t.join(max(0.0, deadline - time.monotonic()))
+            clean = clean and not t.is_alive()
         self._threads = []
+        return clean
+
+    def abort_pending(self, exc):
+        """Fail every handed-off-but-unstarted batch with ``exc`` and
+        return the request count — the drain deadline's
+        checkpoint-and-abort escalation. A request wedged INSIDE a
+        dispatch belongs to its (daemon) worker and is not reclaimed
+        here; its future completes or fails from the guard."""
+        n = 0
+        while True:
+            try:
+                batch = self._handoff.get_nowait()
+            except _stdqueue.Empty:
+                return n
+            for r in batch:
+                r._fail(exc)
+                n += 1
+            self._handoff.task_done()
 
     # -- threads ---------------------------------------------------------
 

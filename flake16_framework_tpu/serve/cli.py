@@ -6,6 +6,7 @@ closed-loop client load, print one JSON stats line.
         [--requests N] [--rows R] [--clients C]
         [--kinds predict,shap] [--buckets 8,32,128]
         [--registry DIR] [--json]
+        [--hold] [--hold-timeout S] [--drain-deadline S]
 
 Without ``--ledger`` it fits + registers the study's two SHAP configs
 (config.SHAP_CONFIGS) on synthetic data; with it, every config the
@@ -14,6 +15,13 @@ the count). ``--registry DIR`` persists the artifacts (register ->
 reload round-trips). ``sustained_load`` is the same closed-loop driver
 bench.py --serve measures with — the CLI is the interactive arm of the
 sustained-throughput benchmark.
+
+``--hold`` is the drain drill's child half (ISSUE 11b): serve a
+closed-loop load until SIGTERM (or ``--hold-timeout``), then
+``ScoringService.drain`` and print one ``DRAIN_ACCT {json}`` line.
+Exit 0 iff the drain completed within the deadline and every client
+request was accounted for (completed, or retriably rejected) — zero
+silent drops. ``tools/chaos_drill.py serve`` is the parent half.
 """
 
 import json
@@ -74,17 +82,76 @@ def sustained_load(service, feats, model_ids, *, n_requests=256, rows=16,
     }
 
 
+def hold_until_signal(service, feats, model_ids, *, rows=16,
+                      kinds=("predict",), clients=8, hold_timeout=120.0,
+                      drain_deadline=10.0):
+    """The drain drill's child half: drive a closed-loop load, print
+    ``SERVE_READY``, wait for SIGTERM/SIGINT (bounded by
+    ``hold_timeout``), then drain. Every client request ends in exactly
+    one bucket — ok (future completed), retriable (drain rejection:
+    safe to resubmit), rejected (non-retriable admission), failed
+    (anything else) — so "zero silently dropped" is checkable from the
+    returned counts alone."""
+    import signal
+
+    from flake16_framework_tpu.serve.queue import RequestRejected
+
+    stop_evt = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop_evt.set())
+    signal.signal(signal.SIGINT, lambda *_: stop_evt.set())
+
+    counts = {"ok": 0, "retriable": 0, "rejected": 0, "failed": 0}
+    lock = threading.Lock()
+    n_clients = max(1, int(clients))
+
+    def client(ci):
+        j = ci
+        while True:
+            model_id = model_ids[j % len(model_ids)]
+            kind = kinds[j % len(kinds)]
+            off = (j * rows) % max(1, feats.shape[0] - rows)
+            try:
+                service.score(model_id, feats[off:off + rows], kind=kind,
+                              timeout=60.0)
+                k = "ok"
+            except Exception as e:
+                k = ("retriable" if getattr(e, "retriable", False)
+                     else "rejected" if isinstance(e, RequestRejected)
+                     else "failed")
+            with lock:
+                counts[k] += 1
+            if k != "ok":
+                return
+
+    threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+               for ci in range(n_clients)]
+    for t in threads:
+        t.start()
+    print("SERVE_READY", flush=True)
+    stop_evt.wait(hold_timeout)
+    acct = service.drain(deadline_s=drain_deadline)
+    for t in threads:
+        t.join(10.0)
+    return {"drain": acct, "counts": dict(counts),
+            "signalled": stop_evt.is_set()}
+
+
 def _parse(args):
     opts = {
         "synth": 512, "trees": 16, "max_depth": 12, "ledger": None,
         "limit": None, "requests": 256, "rows": 16, "clients": 8,
         "kinds": ("predict",), "buckets": (8, 32, 128),
         "registry": None, "json": False,
+        "hold": False, "hold_timeout": 120.0, "drain_deadline": 10.0,
     }
     it = iter(args)
     for a in it:
         if a == "--json":
             opts["json"] = True
+        elif a == "--hold":
+            opts["hold"] = True
+        elif a in ("--hold-timeout", "--drain-deadline"):
+            opts[a[2:].replace("-", "_")] = float(next(it))
         elif a in ("--synth", "--trees", "--max-depth", "--limit",
                    "--requests", "--rows", "--clients"):
             opts[a[2:].replace("-", "_")] = int(next(it))
@@ -127,15 +194,28 @@ def serve_main(args):
                 tree_overrides=overrides, persist=persist)
 
     with ScoringService(registry, buckets=opts["buckets"]) as svc:
-        result = sustained_load(
-            svc, feats, registry.ids(), n_requests=opts["requests"],
-            rows=opts["rows"], kinds=opts["kinds"],
-            clients=opts["clients"])
+        if opts["hold"]:
+            result = hold_until_signal(
+                svc, feats, registry.ids(), rows=opts["rows"],
+                kinds=opts["kinds"], clients=opts["clients"],
+                hold_timeout=opts["hold_timeout"],
+                drain_deadline=opts["drain_deadline"])
+        else:
+            result = sustained_load(
+                svc, feats, registry.ids(), n_requests=opts["requests"],
+                rows=opts["rows"], kinds=opts["kinds"],
+                clients=opts["clients"])
 
     import jax
 
     result["backend"] = jax.default_backend()
     result["models"] = registry.ids()
+    if opts["hold"]:
+        print("DRAIN_ACCT " + json.dumps(result), flush=True)
+        ok = (result["drain"]["phase"] == "complete"
+              and result["counts"]["failed"] == 0
+              and result["counts"]["rejected"] == 0)
+        return 0 if ok else 1
     print(json.dumps(result) if opts["json"]
           else json.dumps(result, indent=1))
     sys.stdout.flush()

@@ -23,12 +23,27 @@ import time
 
 
 class ServeError(RuntimeError):
-    """Base class for scoring-service errors."""
+    """Base class for scoring-service errors. ``retriable`` is the
+    client contract: True means nothing was dispatched on the request's
+    behalf, so resubmitting (to this or a replacement service) is safe
+    and expected; False means the same request would fail again."""
+
+    retriable = False
 
 
 class RequestRejected(ServeError):
     """Request refused at admission (queue full/closed, unknown or
     quarantined model, oversize batch)."""
+
+
+class RetriableRejection(RequestRejected):
+    """Request refused because the service is DRAINING (e.g. SIGTERM
+    landed): it was queued but never handed to a dispatcher, so the
+    client may safely resubmit to the restarted or replacement
+    service. The drain path fails every unstarted request with this —
+    never a silent drop."""
+
+    retriable = True
 
 
 class ScoreRequest:
@@ -84,7 +99,11 @@ class RequestQueue:
     def submit(self, request):
         with self._cond:
             if self._closed:
-                raise RequestRejected("queue closed")
+                # Closed means draining/stopped: nothing was dispatched,
+                # so the rejection is retriable against a replacement.
+                raise RetriableRejection(
+                    "queue closed (draining); resubmit to the "
+                    "replacement service")
             if len(self._items) >= self.maxsize:
                 raise RequestRejected(
                     f"queue full ({self.maxsize} requests)")
@@ -124,3 +143,12 @@ class RequestQueue:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+
+    def drain_pending(self):
+        """Pop and return every queued-but-uncollected request. The
+        drain path calls this right after ``close()`` and fails each
+        with :class:`RetriableRejection` — these were never dispatched,
+        so the rejection is the retry signal, not an error."""
+        with self._cond:
+            items, self._items = self._items, []
+            return items

@@ -20,8 +20,8 @@ The sweep's scores ledger is the artifact *source*: ``configs_from_
 ledger`` reads a (partial or complete) ``scores.pkl`` and returns its
 config keys in canonical grid order, so "serve what the sweep scored"
 is one call. Persistence is one pickle per model under the registry
-root plus a ``registry.json`` index (atomic replace, like every other
-artifact writer in this repo).
+root plus a ``registry.json`` index (``utils.atomic_write``, like every
+other durable-artifact writer in this repo).
 """
 
 import hashlib
@@ -37,6 +37,7 @@ from flake16_framework_tpu import config as cfg
 from flake16_framework_tpu.ops import trees
 from flake16_framework_tpu.ops.preprocess import fit_preprocess, transform
 from flake16_framework_tpu.ops.resample import resample
+from flake16_framework_tpu.utils.atomic import atomic_write
 
 REGISTRY_SCHEMA = "flake16-serve-registry-v1"
 INDEX_FILE = "registry.json"
@@ -232,9 +233,8 @@ class ModelRegistry:
             "mu": np.asarray(model.mu),
             "wmat": np.asarray(model.wmat),
         }
-        with open(path + ".tmp", "wb") as fd:
+        with atomic_write(path, "wb") as fd:
             pickle.dump(record, fd)
-        os.replace(path + ".tmp", path)
         self._write_index()
 
     def _write_index(self):
@@ -250,9 +250,14 @@ class ModelRegistry:
             },
         }
         path = os.path.join(self.root, INDEX_FILE)
-        with open(path + ".tmp", "w") as fd:
+        with atomic_write(path, "w") as fd:
             json.dump(index, fd, indent=1)
-        os.replace(path + ".tmp", path)
+
+    def flush(self):
+        """Re-write the on-disk index from the in-memory map — the
+        drain path's registry flush. Safe on an empty registry."""
+        os.makedirs(self.root, exist_ok=True)
+        self._write_index()
 
     def load(self):
         """Rebuild the in-memory map from the on-disk index. Returns the

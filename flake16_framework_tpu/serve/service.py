@@ -8,18 +8,33 @@ starts the batcher threads. ``submit`` returns the request future;
 ``score`` is the synchronous wrapper. p50/p99 latency and queue depth
 flow through the existing telemetry gauges, so ``report`` and ``trace``
 work unchanged on a serving run.
+
+``drain()`` is the preemption path (ISSUE 11b): admission closes,
+in-flight microbatches complete, queued-but-unstarted requests fail
+with :class:`~flake16_framework_tpu.serve.queue.RetriableRejection`
+(resubmit is safe — nothing was dispatched), and every durable serve
+artifact flushes (registry index, AOT warm manifest, obs manifest).
+Past the deadline the drain escalates to checkpoint-and-abort: the
+flush still runs, handed-off batches fail with a plain ServeError.
+Zero requests are ever silently dropped — each submitted future either
+completes or raises.
 """
 
+import os
 import threading
+import time
 
 import numpy as np
 
 from flake16_framework_tpu import obs
 from flake16_framework_tpu.serve.batcher import Microbatcher
 from flake16_framework_tpu.serve.queue import (
-    RequestQueue, RequestRejected, ScoreRequest,
+    RequestQueue, RequestRejected, RetriableRejection, ScoreRequest,
+    ServeError,
 )
-from flake16_framework_tpu.serve.store import ExecutableStore, KINDS
+from flake16_framework_tpu.serve.store import (
+    ExecutableStore, KINDS, MANIFEST_FILE,
+)
 
 
 class LatencyStats:
@@ -98,6 +113,59 @@ class ScoringService:
         self.requests.close()
         self.batcher.stop()
         self._started = False
+
+    def drain(self, deadline_s=10.0):
+        """Graceful drain (see module docstring): close admission, fail
+        queued requests with RetriableRejection, let in-flight batches
+        complete within ``deadline_s``, then flush durable state. Past
+        the deadline, escalate to checkpoint-and-abort (handed-off
+        batches fail; the flush still runs). Returns the accounting
+        dict the drain drill asserts on: phase (complete|abort) plus
+        completed / rejected / aborted request counts."""
+        t0 = time.perf_counter()
+        done_before = self.latency.snapshot()["count"]
+        obs.event("drain", phase="begin", deadline_s=float(deadline_s))
+        self.requests.close()
+        queued = self.requests.drain_pending()
+        rejection = RetriableRejection(
+            "service draining; resubmit to the replacement service")
+        for r in queued:
+            r._fail(rejection)
+        clean = self.batcher.stop(timeout=deadline_s)
+        aborted = 0
+        if not clean:
+            aborted = self.batcher.abort_pending(ServeError(
+                f"drain deadline ({deadline_s}s) exceeded; "
+                f"batch aborted before dispatch"))
+        self.flush()
+        self._started = False
+        acct = {
+            "phase": "complete" if clean else "abort",
+            "completed": self.latency.snapshot()["count"] - done_before,
+            "rejected": len(queued),
+            "aborted": aborted,
+            "wall_s": round(time.perf_counter() - t0, 3),
+        }
+        obs.event("drain", phase=acct["phase"],
+                  completed=acct["completed"], rejected=acct["rejected"],
+                  aborted=acct["aborted"])
+        return acct
+
+    def flush(self):
+        """Flush durable serve state: the registry index, the AOT warm
+        manifest (signatures computed WITHOUT compiling — the
+        reload-warm contract's check value), and the obs manifest.
+        Returns the manifest path (None for a rootless registry)."""
+        manifest_path = None
+        if getattr(self.registry, "root", None):
+            self.registry.flush()
+            manifest_path = os.path.join(self.registry.root, MANIFEST_FILE)
+            self.store.flush_manifest(
+                manifest_path, self.registry.models(), self.buckets)
+        obs.manifest_update(
+            verb="serve", serve_models=len(self.registry),
+            serve_manifest=manifest_path)
+        return manifest_path
 
     def __enter__(self):
         return self.start()

@@ -23,6 +23,9 @@ then re-raises so the dispatch guard's retry lands on xla — the
 pallas->xla degradation ladder as the failover path (ISSUE 6).
 """
 
+import hashlib
+import json
+
 import jax
 
 from flake16_framework_tpu.obs import aot as _aot
@@ -32,6 +35,15 @@ from flake16_framework_tpu.ops.preprocess import transform
 from flake16_framework_tpu.resilience import ladder
 
 KINDS = ("predict", "shap")
+
+MANIFEST_FILE = "aot_manifest.json"
+MANIFEST_SCHEMA = "flake16-serve-aot-manifest-v1"
+
+
+def signature_digest(sig):
+    """Short stable digest of one executable-dispatch signature — the
+    JSON-able form the warm manifest stores."""
+    return hashlib.sha1(repr(sig).encode()).hexdigest()[:16]
 
 
 def _predict_raw(forest, mu, wmat, x):
@@ -130,6 +142,39 @@ class ExecutableStore:
             "shap": self._shap_xla.signature(
                 self._args(model, x), {"depth": model.depth}),
         }
+
+    def warm_manifest(self, models, buckets):
+        """{model_id: {"kind@bucket": digest}} over every registered
+        (kind, bucket) pair, computed from :meth:`signatures` WITHOUT
+        compiling. Equal manifests before a drain and after a reload
+        mean the reloaded service dispatches through the very
+        executables the drained one warmed — the reload-warm contract's
+        check value (ISSUE 11b)."""
+        out = {}
+        for model in models:
+            entry = {}
+            for bucket in buckets:
+                sigs = self.signatures(model, bucket)
+                for kind in KINDS:
+                    entry[f"{kind}@{int(bucket)}"] = signature_digest(
+                        sigs[kind])
+            out[model.model_id] = entry
+        return out
+
+    def flush_manifest(self, path, models, buckets):
+        """Atomically write the warm manifest JSON — the drain path's
+        AOT-store flush. Returns the manifest dict."""
+        from flake16_framework_tpu.utils.atomic import atomic_write
+
+        manifest = {
+            "schema": MANIFEST_SCHEMA,
+            "backend": jax.default_backend(),
+            "buckets": [int(b) for b in buckets],
+            "models": self.warm_manifest(models, buckets),
+        }
+        with atomic_write(path, "w") as fd:
+            json.dump(manifest, fd, indent=1, sort_keys=True)
+        return manifest
 
     # -- dispatch --------------------------------------------------------
 

@@ -1,0 +1,9 @@
+"""utils — host-side helpers with no jax dependency at import time.
+
+- atomic.py — ``atomic_write``, the sanctioned durable-artifact writer
+  (tmp + fsync + ``os.replace``; f16lint J701 flags bypasses)
+- relay.py  — TPU-tunnel liveness diagnosis
+- synth.py  — synthetic reference-schema dataset generation
+"""
+
+from flake16_framework_tpu.utils.atomic import atomic_write  # noqa: F401

@@ -1,0 +1,74 @@
+"""atomic_write — the one sanctioned durable-artifact writer (ISSUE 11).
+
+Every artifact that must survive a process kill (scores/SHAP pickles,
+timing/quarantine sidecars, serve registry index, obs manifest, bench
+outputs) goes through this function. The contract is full
+crash-consistency, one notch stronger than the tmp+``os.replace`` idiom
+scattered through the pre-ISSUE-11 tree:
+
+- the payload is written to a ``tempfile.mkstemp`` sibling in the SAME
+  directory (same filesystem, so the final rename is atomic);
+- the file is flushed and ``os.fsync``'d BEFORE the rename — without
+  this, a rename can land while the data blocks are still dirty, and a
+  power cut yields a zero-length "committed" artifact;
+- ``os.replace`` publishes it atomically;
+- the containing directory is fsync'd so the rename itself is durable.
+
+f16lint's J701 rule flags write-mode ``open()`` on any other package
+path, so new artifact writers cannot silently regress to torn writes.
+"""
+
+import contextlib
+import os
+import tempfile
+
+
+def _fsync_dir(dirname):
+    """Make a just-completed rename durable. Best-effort: some
+    filesystems (and non-POSIX hosts) refuse O_RDONLY fsync on a dir."""
+    try:
+        dfd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dfd)
+
+
+@contextlib.contextmanager
+def atomic_write(path, mode="wb", *, fsync=True, **open_kw):
+    """Context manager yielding a file object; on clean exit the payload
+    is fsync'd and atomically renamed onto ``path``. On ANY exception the
+    temp file is removed and ``path`` is untouched — a crashed writer can
+    never leave a torn artifact, only the previous complete one.
+
+    ``mode`` is "wb" (default) or "w" (text; pass ``encoding=`` through
+    ``open_kw``). ``fsync=False`` keeps the atomic-rename property but
+    skips the durability syncs — for large, cheaply-recomputed artifacts
+    where the caller explicitly trades durability for wall time.
+    """
+    path = os.fspath(path)
+    dirname = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(
+        dir=dirname, prefix=os.path.basename(path) + ".", suffix=".tmp")
+    try:
+        # mkstemp creates 0600; artifacts are shared read like any
+        # open()-created file would have been.
+        os.chmod(tmp, 0o644)
+        with os.fdopen(fd, mode, **open_kw) as out:
+            yield out
+            out.flush()
+            if fsync:
+                os.fsync(out.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if fsync:
+        _fsync_dir(dirname)

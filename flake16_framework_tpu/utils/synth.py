@@ -83,7 +83,9 @@ def make_tests_json(path=None, n_tests=2000, n_projects=26, seed=0,
         tests[proj] = tests_proj
 
     if path is not None:
-        with open(path, "w") as fd:
+        from flake16_framework_tpu.utils.atomic import atomic_write
+
+        with atomic_write(path, "w") as fd:
             json.dump(tests, fd, indent=4)
 
     return tests

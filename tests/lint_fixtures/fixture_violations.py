@@ -68,6 +68,11 @@ def serve_blocking(y):
     return jax.block_until_ready(y)                # expect J601
 
 
+def torn_artifact_write(doc):
+    with open("/tmp/artifact.json", "w") as fd:    # expect J701
+        fd.write(doc)
+
+
 def suppressed_examples(xs):
     """Inline suppressions — test_lint.py asserts these do NOT surface."""
     jax.debug.print("kept = {}", xs)  # f16lint: disable=J401

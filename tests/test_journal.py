@@ -1,0 +1,274 @@
+"""Write-ahead sweep journal + supervisor (ISSUE 11).
+
+Unit tier: record/replay roundtrip, torn-tail truncation, fingerprint
+reset, writer-lock exclusion with dead-pid takeover — the concurrent
+-resume contracts. Integration tier: an in-process preemption mid-config
+(KeyboardInterrupt delivered at a fold-append point — the same program
+point where the chaos harness delivers SIGKILL) followed by a resume
+whose final scores are bit-identical to an uninterrupted run. The
+process-level version of that drill (real SIGKILL, supervised restart)
+is tools/chaos_drill.py; tests/test_resilience.py covers the fault
+ladder the journal composes with.
+"""
+
+import os
+import pickle
+import signal
+import struct
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flake16_framework_tpu.pipeline import write_scores  # noqa: E402
+from flake16_framework_tpu.resilience import (  # noqa: E402
+    inject, journal as rjournal, supervisor,
+)
+from flake16_framework_tpu.utils.synth import make_tests_json  # noqa: E402
+
+FP = ("schema", 1, "probe")
+
+
+def _folds(jr, keys, n=3):
+    for f in range(n):
+        jr.record_fold(keys, f, struct.pack("<II", 7, f),
+                       np.full((2, 3, 3), f, np.int32))
+
+
+# -- record/replay roundtrip ---------------------------------------------
+
+
+def test_roundtrip_fold_and_config_records(tmp_path):
+    path = str(tmp_path / "scores.pkl.journal")
+    ka, kb = ("a",) * 5, ("b",) * 5
+    with rjournal.SweepJournal.open(path, FP, warn_out=None) as jr:
+        _folds(jr, ka, n=3)
+        jr.record_config(ka, [0.1, 0.2, {"p": 1}, [3]])
+        _folds(jr, kb, n=2)
+
+    rep = rjournal.replay(path, fingerprint=FP, warn_out=None)
+    assert not rep.truncated and rep.reset_reason is None
+    # a completed config supersedes its fold records
+    assert rep.ledger == {ka: [0.1, 0.2, {"p": 1}, [3]]}
+    assert set(rep.partial) == {kb} and set(rep.partial[kb]) == {0, 1}
+    key_bytes, counts = rep.partial[kb][1]
+    assert key_bytes == struct.pack("<II", 7, 1)
+    np.testing.assert_array_equal(counts, np.full((2, 3, 3), 1, np.int32))
+
+    # reopening hands the recovered state to the writer
+    jr = rjournal.SweepJournal.open(path, FP, warn_out=None)
+    assert jr.ledger == rep.ledger
+    pf = jr.partial_folds(kb)
+    assert set(pf) == set(rep.partial[kb])
+    for f in pf:
+        assert pf[f][0] == rep.partial[kb][f][0]
+        np.testing.assert_array_equal(pf[f][1], rep.partial[kb][f][1])
+    assert jr.partial_folds(("fresh",) * 5) == {}
+    jr.finalize()
+    assert not os.path.exists(path)
+    assert not os.path.exists(rjournal.lock_path(path))
+
+
+def test_torn_tail_truncated_on_reopen(tmp_path):
+    """A crash mid-append leaves a torn record; replay keeps the valid
+    prefix, reopen truncates the tail, and appends continue cleanly."""
+    path = str(tmp_path / "scores.pkl.journal")
+    ka = ("a",) * 5
+    with rjournal.SweepJournal.open(path, FP, warn_out=None) as jr:
+        _folds(jr, ka, n=2)
+    good_size = os.path.getsize(path)
+    with open(path, "ab") as fd:  # length prefix promises 100 bytes...
+        fd.write(struct.pack("<II", 100, 0) + b"xy")  # ...delivers 2
+
+    rep = rjournal.replay(path, fingerprint=FP, warn_out=None)
+    assert rep.truncated and set(rep.partial[ka]) == {0, 1}
+    assert rep.valid_end == good_size
+
+    with rjournal.SweepJournal.open(path, FP, warn_out=None) as jr:
+        assert os.path.getsize(path) == good_size  # tail gone
+        _folds(jr, ka, n=3)
+    rep = rjournal.replay(path, fingerprint=FP, warn_out=None)
+    assert not rep.truncated and set(rep.partial[ka]) == {0, 1, 2}
+
+
+def test_corrupt_payload_cut_at_crc(tmp_path):
+    """A bit-flip inside a record's payload fails the CRC: that record and
+    everything after it are discarded, records before it survive."""
+    path = str(tmp_path / "scores.pkl.journal")
+    ka = ("a",) * 5
+    with rjournal.SweepJournal.open(path, FP, warn_out=None) as jr:
+        _folds(jr, ka, n=3)
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    rep = rjournal.replay(path, fingerprint=FP, warn_out=None)
+    assert rep.truncated and set(rep.partial[ka]) == {0, 1}
+
+
+def test_fingerprint_mismatch_resets(tmp_path):
+    """A journal from a DIFFERENT sweep shape/seed must never feed resume
+    state into this one: the whole journal is discarded, not merged."""
+    path = str(tmp_path / "scores.pkl.journal")
+    with rjournal.SweepJournal.open(path, FP, warn_out=None) as jr:
+        _folds(jr, ("a",) * 5, n=2)
+    jr = rjournal.SweepJournal.open(path, ("other", 2), warn_out=None)
+    assert jr.reset_reason == "fingerprint mismatch"
+    assert jr.ledger == {} and jr.partial == {}
+    _folds(jr, ("b",) * 5, n=1)
+    jr.close()
+    rep = rjournal.replay(path, fingerprint=("other", 2), warn_out=None)
+    assert rep.reset_reason is None and set(rep.partial) == {("b",) * 5}
+
+
+# -- concurrent resume: writer-lock exclusion ----------------------------
+
+
+def test_second_live_resumer_excluded(tmp_path):
+    path = str(tmp_path / "scores.pkl.journal")
+    jr = rjournal.SweepJournal.open(path, FP, warn_out=None)
+    with pytest.raises(rjournal.JournalLocked, match="live pid"):
+        rjournal.SweepJournal.open(path, FP, warn_out=None)
+    jr.close()  # release WITHOUT removing: a later resume may continue
+    jr2 = rjournal.SweepJournal.open(path, FP, warn_out=None)
+    jr2.close()
+
+
+def test_stale_lock_from_dead_pid_taken_over(tmp_path):
+    """A SIGKILLed run leaves its lock behind; the restarted run must take
+    it over (the pid is provably dead), not deadlock forever."""
+    path = str(tmp_path / "scores.pkl.journal")
+    proc = subprocess.run([sys.executable, "-c", "import os; print(os.getpid())"],
+                          capture_output=True, text=True)
+    dead_pid = int(proc.stdout)
+    with open(rjournal.lock_path(path), "w") as fd:
+        fd.write(str(dead_pid))
+    jr = rjournal.SweepJournal.open(path, FP, warn_out=None)
+    _folds(jr, ("a",) * 5, n=1)
+    jr.close()
+    # garbage lock content is also stale, never a deadlock
+    with open(rjournal.lock_path(path), "w") as fd:
+        fd.write("not-a-pid")
+    rjournal.SweepJournal.open(path, FP, warn_out=None).close()
+
+
+# -- fold-granular preemption + resume: bit-identical scores -------------
+
+
+PREEMPT_CONFIGS = [
+    ("NOD", "Flake16", "None", "None", "Extra Trees"),
+    ("OD", "Flake16", "None", "None", "Extra Trees"),
+]
+TINY = {"Extra Trees": 4, "Random Forest": 4}
+
+
+def test_preempt_mid_config_resume_bit_identical(tmp_path, monkeypatch):
+    """Preemption at a fold-append point — config 0 journaled complete,
+    config 1 journaled through fold 3 — then resume. The resumed run
+    replays the journal, reruns ONLY unfinished folds with the journaled
+    rng keys, and its scores content is bit-identical to an uninterrupted
+    run (v[2:]; v[:2] are wall clocks)."""
+    monkeypatch.chdir(tmp_path)
+    make_tests_json("tests.json", n_tests=100, n_projects=3, seed=11)
+    kw = dict(configs=PREEMPT_CONFIGS, max_depth=8, tree_overrides=TINY,
+              progress_out=open(os.devnull, "w"))
+
+    ref = write_scores(out_file="scores-ref.pkl", **kw)
+
+    calls = {"n": 0}
+    orig = rjournal.SweepJournal.record_fold
+
+    def preempting(self, *a, **k):
+        out = orig(self, *a, **k)
+        calls["n"] += 1
+        if calls["n"] == 14:  # config 0: folds 1-10; config 1: folds 1-4
+            raise KeyboardInterrupt
+        return out
+
+    monkeypatch.setattr(rjournal.SweepJournal, "record_fold", preempting)
+    with pytest.raises(KeyboardInterrupt):
+        write_scores(out_file="scores.pkl", **kw)
+    monkeypatch.setattr(rjournal.SweepJournal, "record_fold", orig)
+
+    jpath = rjournal.journal_path("scores.pkl")
+    rep = rjournal.replay(jpath, warn_out=None)
+    # exactly the 14 journaled folds survive, as config records (10 folds
+    # superseded) or partial folds — the batched path journals all of a
+    # batch's folds before any config record, the singles path interleaves
+    folds_recovered = (10 * len(rep.ledger)
+                       + sum(len(v) for v in rep.partial.values()))
+    assert folds_recovered == 14
+
+    import io
+    import re
+
+    plog = io.StringIO()
+    resumed = write_scores(out_file="scores.pkl", **dict(kw, progress_out=plog))
+    m = re.search(r"journal: replayed (\d+) completed config\(s\) and "
+                  r"(\d+) partial fold\(s\)", plog.getvalue())
+    assert m and 10 * int(m.group(1)) + int(m.group(2)) == 14
+    assert set(resumed) == set(ref)
+    for k in ref:
+        assert pickle.dumps(resumed[k][2:]) == pickle.dumps(ref[k][2:])
+    assert not os.path.exists(jpath)  # finalized
+    on_disk = pickle.load(open("scores.pkl", "rb"))
+    for k in ref:
+        assert pickle.dumps(on_disk[k][2:]) == pickle.dumps(ref[k][2:])
+
+
+# -- supervisor ----------------------------------------------------------
+
+
+CHILD = textwrap.dedent("""\
+    import os, signal, sys
+    marker = sys.argv[1]
+    mode = sys.argv[2]
+    spec = os.environ.get("F16_FAULT_INJECT", "")
+    if not os.path.exists(marker):
+        open(marker, "w").write(spec)
+        if mode in ("die-once", "die-always"):
+            os.kill(os.getpid(), signal.SIGKILL)
+    elif mode == "die-always":
+        os.kill(os.getpid(), signal.SIGKILL)
+    open(marker + ".final", "w").write(spec)
+    sys.exit(int(sys.argv[3]) if len(sys.argv) > 3 else 0)
+    """)
+
+
+def _child_argv(tmp_path, mode, *extra):
+    script = tmp_path / "child.py"
+    script.write_text(CHILD)
+    return [sys.executable, str(script), str(tmp_path / "marker"), mode,
+            *extra]
+
+
+def test_supervise_restarts_signal_death_and_strips_chaos(tmp_path):
+    env = dict(os.environ)
+    env[inject.ENV_VAR] = "5:3:sigkill;7:1:transient"
+    rc, history = supervisor.supervise(
+        _child_argv(tmp_path, "die-once"), env=env, warn_out=None)
+    assert rc == 0
+    assert [h["signal"] for h in history] == [signal.SIGKILL]
+    # first child saw the full plan; the restarted child got the process
+    # (kill) entries stripped so the injected death fires exactly once,
+    # while the in-process fault entries survive the restart
+    assert (tmp_path / "marker").read_text() == "5:3:sigkill;7:1:transient"
+    assert (tmp_path / "marker.final").read_text() == "7:1:transient"
+
+
+def test_supervise_nonzero_exit_not_restarted(tmp_path):
+    rc, history = supervisor.supervise(
+        _child_argv(tmp_path, "clean", "7"), warn_out=None)
+    assert rc == 7 and history == []
+    assert (tmp_path / "marker.final").exists()
+
+
+def test_supervise_restart_budget_exceeded(tmp_path):
+    with pytest.raises(supervisor.RestartBudgetExceeded) as ei:
+        supervisor.supervise(_child_argv(tmp_path, "die-always"),
+                             max_restarts=2, warn_out=None)
+    assert len(ei.value.history) == 3  # initial death + 2 restarted deaths
+    assert all(h["signal"] == signal.SIGKILL for h in ei.value.history)

@@ -30,9 +30,12 @@ from flake16_framework_tpu.resilience import (  # noqa: E402
 )
 from flake16_framework_tpu.serve import (  # noqa: E402
     ExecutableStore, ModelRegistry, RequestQueue, RequestRejected,
-    ScoreRequest, ScoringService, artifact_signature, model_id_for,
+    RetriableRejection, ScoreRequest, ScoringService, artifact_signature,
+    model_id_for,
 )
 from flake16_framework_tpu.serve import registry as registry_mod  # noqa: E402
+from flake16_framework_tpu.serve import store as store_mod  # noqa: E402
+from flake16_framework_tpu.serve.queue import ServeError  # noqa: E402
 from flake16_framework_tpu.utils.synth import make_dataset  # noqa: E402
 
 # One tiny tree config (cheapest fit+compile: single tree, no hist path)
@@ -359,3 +362,94 @@ def test_serve_cli_smoke(capsys):
     assert stats["requests"] == 8 and stats["rps"] > 0
     assert stats["p99_ms"] is not None
     assert len(stats["models"]) == 2
+
+
+# -- graceful drain (ISSUE 11) -------------------------------------------
+
+
+def test_drain_under_load_completes_and_flushes(registry, data):
+    """SIGTERM's in-process half: admission close -> in-flight complete ->
+    flush. Every submitted request either completes or fails RETRIABLY
+    (nothing dropped), post-drain submits are retriable rejections, and
+    the flushed AOT manifest reloads warm (fresh registry + uncompiled
+    store reproduce its signature digests)."""
+    feats, _ = data
+    svc = ScoringService(registry, buckets=BUCKETS)
+    svc.start()
+    model_id = registry.ids()[0]
+    reqs = [svc.submit(model_id, feats[:3]) for _ in range(6)]
+    acct = svc.drain(deadline_s=30.0)
+    assert acct["phase"] == "complete" and acct["aborted"] == 0
+
+    done = retried = 0
+    for r in reqs:
+        try:
+            out = r.result(timeout=5)
+            assert out.shape[0] == 3
+            done += 1
+        except RetriableRejection:
+            retried += 1
+    assert done + retried == 6          # zero dropped
+    assert acct["rejected"] == retried
+    assert acct["completed"] >= done
+
+    with pytest.raises(RetriableRejection) as ei:
+        svc.submit(model_id, feats[:3])
+    assert ei.value.retriable is True
+    assert isinstance(ei.value, RequestRejected)  # old callers still catch
+
+    manifest_path = os.path.join(registry.root, store_mod.MANIFEST_FILE)
+    assert os.path.exists(manifest_path)
+    manifest = json.load(open(manifest_path))
+    assert manifest["schema"] == store_mod.MANIFEST_SCHEMA
+    assert tuple(manifest["buckets"]) == BUCKETS
+    fresh = ModelRegistry(registry.root)
+    fresh.load()
+    rebuilt = ExecutableStore(fresh).warm_manifest(
+        fresh.models(), tuple(manifest["buckets"]))
+    assert rebuilt == manifest["models"]
+
+
+def test_drain_rejects_queued_retriably(data):
+    """Queue half of the drain contract: close() + drain_pending() hands
+    back the unstarted requests; failing them with RetriableRejection
+    reaches every waiting future."""
+    feats, _ = data
+    q = RequestQueue(maxsize=4)
+    reqs = [ScoreRequest("m", feats[:2]) for _ in range(3)]
+    for r in reqs:
+        q.submit(r)
+    q.close()
+    with pytest.raises(RetriableRejection, match="resubmit"):
+        q.submit(ScoreRequest("m", feats[:2]))
+    items = q.drain_pending()
+    assert items == reqs and q.drain_pending() == []
+    exc = RetriableRejection("draining")
+    for r in items:
+        r._fail(exc)
+    for r in reqs:
+        with pytest.raises(RetriableRejection):
+            r.result(timeout=1)
+
+
+def test_drain_deadline_escalates_to_abort(registry, data, monkeypatch):
+    """Past the deadline the drain checkpoints-and-aborts: handed-off but
+    undispatched batches fail with a non-retriable ServeError, the flush
+    still runs, and the accounting says phase=abort."""
+    feats, _ = data
+    svc = ScoringService(registry, buckets=BUCKETS)
+    svc.start()
+    real_stop = svc.batcher.stop
+    monkeypatch.setattr(svc.batcher, "stop", lambda timeout=5.0: False)
+    wedged = [ScoreRequest(registry.ids()[0], feats[:2]) for _ in range(2)]
+    svc.batcher._handoff.put(list(wedged))
+    acct = svc.drain(deadline_s=0.01)
+    assert acct["phase"] == "abort" and acct["aborted"] == 2
+    for r in wedged:
+        with pytest.raises(ServeError) as ei:
+            r.result(timeout=1)
+        assert not getattr(ei.value, "retriable", False)
+        assert "deadline" in str(ei.value)
+    assert os.path.exists(os.path.join(registry.root,
+                                       store_mod.MANIFEST_FILE))
+    real_stop(timeout=10)  # reclaim the (healthy) dispatcher threads

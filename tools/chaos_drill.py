@@ -1,0 +1,266 @@
+"""Process-level chaos harness: the two ISSUE-11 acceptance drills with
+no human in the loop.
+
+    python tools/chaos_drill.py sweep    # the kill drill
+    python tools/chaos_drill.py serve    # the drain drill
+    python tools/chaos_drill.py          # both; exit 0 iff every drill PASSes
+    python tools/chaos_drill.py --json   # machine-readable verdicts
+    python tools/chaos_drill.py --keep   # keep scratch dirs (debugging)
+
+The kill drill (sweep): a tiny synthetic sweep (3 configs, 4-tree
+forests) runs twice — once uninterrupted (the reference), once with
+``F16_FAULT_INJECT=<config>:<fold>:sigkill`` so the write-ahead journal
+delivers SIGKILL right after fsyncing that fold's record, under
+``resilience.supervise`` so the death is restarted with the chaos entry
+stripped. PASS requires: exactly one signal-9 death in the supervisor
+history, final rc 0, a ``journal: replayed`` line in the restarted
+child's log (completed configs + partial folds > 0 — proof the rerun
+skipped finished work), and the two scores pickles bit-identical in
+scores content (``pickle.dumps(v[2:])`` per config; v[:2] are wall
+clocks, which legitimately differ).
+
+The drain drill (serve): spawns ``python -m flake16_framework_tpu serve
+--hold --registry DIR`` as a child, waits for its SERVE_READY line (AOT
+warm-up done, client load running), sends SIGTERM, and parses the
+DRAIN_ACCT accounting it prints after draining. PASS requires: child
+exit 0, drain phase "complete", zero failed and zero non-retriable
+rejections across the client load (in-flight completed; queued requests
+got RETRIABLE rejections only), and reload-warm: a fresh ModelRegistry +
+ExecutableStore over the flushed registry dir reproduces the flushed
+``aot_manifest.json`` signature digests exactly, so a replacement
+process compiles nothing new.
+
+Both drills pin JAX_PLATFORMS=cpu unless the caller overrides it, and
+share the persistent XLA compile cache with the test suite (same default
+dir as tests/conftest.py), so repeat runs are cheap. recovery_watch.py
+runs this as its ``chaos`` stage.
+"""
+
+import json
+import os
+import pickle
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Backend pins BEFORE any package/jax import: the drill is a CPU-grade
+# determinism check (bit-identity comes from the journal's rng-key
+# discipline, not the backend), and the shared persistent compile cache
+# makes the four child processes affordable.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "f16-jax-compile-cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("F16_FAULT_BACKOFF_S", "0")
+
+# Same probe shapes as the tests' acceptance drills (tests/test_resilience
+# .py): 3 projects x 100 tests, 4-tree forests, depth 8 — seconds per run.
+SWEEP_CONFIGS = [
+    ("NOD", "Flake16", "None", "None", "Extra Trees"),
+    ("OD", "Flake16", "None", "None", "Extra Trees"),
+    ("OD", "Flake16", "Scaling", "SMOTE", "Extra Trees"),
+]
+KILL_CONFIG = 1   # die mid-sweep: config 0 already journalled complete
+KILL_FOLD = 5     # ...and mid-config: folds 1-5 journalled, 6-10 not
+
+RUNNER_TEMPLATE = """\
+import sys
+sys.path.insert(0, {repo!r})
+from flake16_framework_tpu.pipeline import write_scores
+write_scores(tests_file={tests!r}, out_file=sys.argv[1],
+             configs={configs!r}, max_depth=8,
+             tree_overrides={{"Extra Trees": 4, "Random Forest": 4}})
+"""
+
+
+def log(msg):
+    print(f"chaos_drill: {msg}", flush=True)
+
+
+def drill_sweep(workdir):
+    """SIGKILL mid-config -> supervised restart -> journal replay ->
+    scores bit-identical. Returns a verdict dict."""
+    from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.resilience import inject
+    from flake16_framework_tpu.resilience.supervisor import supervise
+    from flake16_framework_tpu.utils.synth import make_tests_json
+
+    t0 = time.perf_counter()
+    tests = os.path.join(workdir, "tests.json")
+    make_tests_json(tests, n_tests=100, n_projects=3, seed=11)
+    runner = os.path.join(workdir, "runner.py")
+    with open(runner, "w") as fd:
+        fd.write(RUNNER_TEMPLATE.format(
+            repo=REPO, tests=tests, configs=SWEEP_CONFIGS))
+
+    checks = {}
+
+    def run_ref():
+        out = os.path.join(workdir, "scores-ref.pkl")
+        r = subprocess.run(
+            [sys.executable, runner, out], cwd=workdir,
+            stdout=open(os.path.join(workdir, "ref.log"), "w"),
+            stderr=subprocess.STDOUT)
+        checks["ref_rc0"] = r.returncode == 0
+        return out
+
+    log("sweep: reference (uninterrupted) run")
+    ref_out = run_ref()
+
+    kill_idx = list(cfg.iter_config_keys()).index(SWEEP_CONFIGS[KILL_CONFIG])
+    chaos_out = os.path.join(workdir, "scores-chaos.pkl")
+    chaos_log = os.path.join(workdir, "chaos.log")
+    env = dict(os.environ)
+    env[inject.ENV_VAR] = f"{kill_idx}:{KILL_FOLD}:sigkill"
+    log(f"sweep: chaos run, SIGKILL at config {kill_idx} fold {KILL_FOLD}")
+    with open(chaos_log, "w") as lf:
+        rc, history = supervise(
+            [sys.executable, runner, chaos_out], env=env, cwd=workdir,
+            stdout=lf, stderr=lf, warn_out=lf)
+
+    checks["chaos_rc0"] = rc == 0
+    checks["one_sigkill_death"] = (
+        len(history) == 1 and history[0]["signal"] == signal.SIGKILL)
+    m = re.search(r"journal: replayed (\d+) completed config\(s\) and "
+                  r"(\d+) partial fold\(s\)", open(chaos_log).read())
+    checks["replay_line"] = m is not None
+    if m:
+        # killed mid-config: the restart must inherit BOTH kinds of state
+        checks["replayed_complete_configs"] = int(m.group(1)) >= 1
+        checks["replayed_partial_folds"] = int(m.group(2)) >= 1
+
+    if checks["ref_rc0"] and checks["chaos_rc0"]:
+        ref = pickle.load(open(ref_out, "rb"))
+        chaos = pickle.load(open(chaos_out, "rb"))
+        checks["same_configs"] = set(ref) == set(chaos) == set(SWEEP_CONFIGS)
+        checks["scores_bit_identical"] = all(
+            pickle.dumps(ref[k][2:]) == pickle.dumps(chaos[k][2:])
+            for k in ref)
+        # journal gone after a durably-finalized sweep
+        checks["journal_finalized"] = not os.path.exists(
+            chaos_out + ".journal")
+
+    return {"drill": "sweep", "pass": all(checks.values()),
+            "checks": checks, "wall_s": round(time.perf_counter() - t0, 2)}
+
+
+def drill_serve(workdir):
+    """SIGTERM under load -> graceful drain -> zero dropped -> flushed
+    registry/AOT manifest reloads warm. Returns a verdict dict."""
+    t0 = time.perf_counter()
+    reg_dir = os.path.join(workdir, "registry")
+    argv = [sys.executable, "-m", "flake16_framework_tpu", "serve",
+            "--hold", "--registry", reg_dir, "--synth", "256",
+            "--trees", "4", "--max-depth", "8", "--buckets", "8,32",
+            "--rows", "8", "--clients", "6",
+            "--hold-timeout", "180", "--drain-deadline", "10"]
+    log("serve: spawning held service " + " ".join(argv[2:]))
+    err_log = os.path.join(workdir, "serve.err")
+    proc = subprocess.Popen(
+        argv, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=open(err_log, "w"), text=True)
+    # Watchdog: a child that never reaches SERVE_READY/DRAIN_ACCT (e.g. a
+    # wedged warm-up) must not hang the drill — readline() below blocks.
+    watchdog = threading.Timer(600, proc.kill)
+    watchdog.start()
+
+    checks, acct = {}, None
+    try:
+        ready = False
+        for line in proc.stdout:
+            line = line.rstrip("\n")
+            if line == "SERVE_READY" and not ready:
+                ready = True
+                time.sleep(0.5)  # let the client load queue requests
+                log("serve: SERVE_READY seen; sending SIGTERM")
+                proc.send_signal(signal.SIGTERM)
+            elif line.startswith("DRAIN_ACCT "):
+                acct = json.loads(line[len("DRAIN_ACCT "):])
+        rc = proc.wait(timeout=60)
+    finally:
+        watchdog.cancel()
+        if proc.poll() is None:
+            proc.kill()
+
+    checks["ready_seen"] = ready
+    checks["rc0"] = rc == 0
+    checks["acct_printed"] = acct is not None
+    if acct:
+        counts = acct["counts"]
+        checks["drain_complete"] = acct["drain"]["phase"] == "complete"
+        checks["nothing_aborted"] = acct["drain"]["aborted"] == 0
+        checks["some_completed"] = counts["ok"] > 0
+        # zero dropped: every client request either completed or came
+        # back RETRIABLE; no hard rejections, no exceptions
+        checks["zero_dropped"] = (
+            counts["failed"] == 0 and counts["rejected"] == 0)
+
+    # Reload-warm: a fresh registry + UNCOMPILED store must reproduce the
+    # flushed manifest's signature digests — the replacement process will
+    # hit the AOT cache, not the compiler.
+    manifest_path = os.path.join(reg_dir, "aot_manifest.json")
+    checks["manifest_flushed"] = os.path.exists(manifest_path)
+    if checks["manifest_flushed"]:
+        from flake16_framework_tpu.serve.registry import ModelRegistry
+        from flake16_framework_tpu.serve.store import (
+            ExecutableStore, MANIFEST_SCHEMA)
+
+        manifest = json.load(open(manifest_path))
+        checks["manifest_schema"] = manifest.get("schema") == MANIFEST_SCHEMA
+        registry = ModelRegistry(reg_dir)
+        registry.load()
+        store = ExecutableStore(registry)
+        rebuilt = store.warm_manifest(
+            registry.models(), tuple(manifest["buckets"]))
+        checks["reload_warm"] = rebuilt == manifest["models"]
+
+    return {"drill": "serve", "pass": all(checks.values()),
+            "checks": checks, "wall_s": round(time.perf_counter() - t0, 2)}
+
+
+def main(argv=None):
+    args = sys.argv[1:] if argv is None else list(argv)
+    as_json = "--json" in args
+    keep = "--keep" in args
+    names = [a for a in args if not a.startswith("--")] or ["sweep", "serve"]
+    drills = {"sweep": drill_sweep, "serve": drill_serve}
+    unknown = [n for n in names if n not in drills]
+    if unknown:
+        raise SystemExit(f"chaos_drill: unknown drill(s) {unknown}; "
+                         f"choose from {sorted(drills)}")
+
+    results = []
+    for name in names:
+        workdir = tempfile.mkdtemp(prefix=f"f16-chaos-{name}-")
+        res = drills[name](workdir)
+        results.append(res)
+        if res["pass"] and not keep:
+            import shutil
+            shutil.rmtree(workdir, ignore_errors=True)
+        else:
+            res["workdir"] = workdir
+        log(f"{name}: {'PASS' if res['pass'] else 'FAIL'} "
+            f"({res['wall_s']}s)" +
+            ("" if res["pass"] else f" — see {workdir}"))
+
+    if as_json:
+        print(json.dumps({"pass": all(r["pass"] for r in results),
+                          "drills": results}, indent=1))
+    else:
+        for r in results:
+            bad = [k for k, v in r["checks"].items() if not v]
+            print(f"{r['drill']}: {'PASS' if r['pass'] else 'FAIL'}"
+                  + (f"  failed checks: {bad}" if bad else ""))
+    return 0 if all(r["pass"] for r in results) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -14,6 +14,9 @@ Chain on recovery (each stage bounded, logged to _scratch/watcher_r03.log):
                                  catches a listener with a dead upstream)
   2. hw_probe full stages      — per-stage timings, pre-warms .jax_cache
   3. bench.py                  — headline JSON -> _scratch/bench_tpu.json
+     (+ bench.py --serve, then the CPU-pinned chaos_drill kill/drain
+      acceptance -> _scratch/chaos_drill.json; chaos FAIL is logged,
+      never aborts the device chain)
   4. parity.py --full          — PARITY.json at repo root (±0.01 criterion)
   5. hw_probe tune_hist+shap   — knob sweeps (results-neutral: per-node
                                  RNG keys derive from node ids; the SHAP
@@ -290,6 +293,26 @@ def chain():
     persist_bench_json(out_s, "bench_serve_tpu.json")
     if not stage_ok_to_continue(ok_s, err):
         return False
+    # Crash-tolerance drills (ISSUE 11): the kill drill (SIGKILL mid-fold
+    # -> supervised restart -> journal replay -> bit-identical scores) and
+    # the drain drill (SIGTERM -> graceful drain -> reload-warm manifest).
+    # chaos_drill pins its children to JAX_PLATFORMS=cpu, so this never
+    # holds the device while the up-window burns; the verdict JSON is
+    # banked for PROFILE.md. A FAIL is host-side robustness evidence, not
+    # tunnel evidence, so it is recorded but does not abort the chain.
+    ok_c, out_c, _ = run_stage(
+        "chaos", [py, os.path.join(REPO, "tools", "chaos_drill.py"),
+                  "--json"], 1800)
+    if out_c and "{" in out_c:
+        try:
+            rec = json.loads(out_c[out_c.index("{"):])
+            with open(os.path.join(REPO, "_scratch",
+                                   "chaos_drill.json"), "w") as fd:
+                json.dump(rec, fd, indent=1)
+        except (ValueError, OSError):
+            pass
+    if not ok_c:
+        log("chaos drills FAILED — continuing device chain (see log)")
     # parity --full judges the hist (production) tier since ISSUE 9 —
     # the exact fallback tier no longer gates the headline record, so
     # parity runs BEFORE the exact-seed bank. The exact-tier sub-record
