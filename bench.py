@@ -107,6 +107,15 @@ def bench_fused(backend=None):
         backend = jax.default_backend()
     return backend != "cpu"
 
+# Planner/executor mode (round 8, ISSUE 12): the scores stage runs the
+# probe through SweepEngine.run_grid in planner_mode — ONE fused program
+# per (family, shape) plan (parallel/planner.py) instead of a dispatch
+# per config — the structural fix for the r07 engine-tax regression.
+# BENCH_PLAN=0 restores the per-config/batched paths (the r07-and-earlier
+# measurement and the hw_probe A/B arm); BENCH_BATCH>1 also wins, since
+# it explicitly requests the config-batched SPMD path.
+BENCH_PLAN = int(os.environ.get("BENCH_PLAN", "1")) != 0
+
 # Probe configs (BASELINE.json "configs" №1-3 + family coverage).
 CONFIGS = [
     ("NOD", "Flake16", "None", "None", "Decision Tree"),
@@ -295,6 +304,7 @@ def make_bench_engine(feats, labels, projects, names, pids, n_trees):
                                dispatch_trees=DISPATCH_TREES,
                                dispatch_folds=DISPATCH_FOLDS,
                                fused=bench_fused(),
+                               planner_mode=BENCH_PLAN and batch_n <= 1,
                                mesh=sweep.default_mesh() if batch_n > 1
                                else None)
     return engine, batch_n
@@ -333,31 +343,45 @@ def worker(n_tests, n_trees):
 
     # Warm-up: compile each work-unit shape once (steady-state measurement —
     # one compile serves all configs of a family across the full 216 grid).
-    seen = set()
-    for unit in groups():
-        shape = (unit[0][1], unit[0][4], len(unit))
-        if shape not in seen:
-            run_unit(unit)
-            seen.add(shape)
-            print(f"warmed {shape}", file=sys.stderr, flush=True)
+    # Planner mode warms by running the grid once: run_grid plans the probe
+    # configs and compiles one program per (family, shape) plan.
+    if engine.planner_mode:
+        engine.run_grid(CONFIGS)
+        print(f"warmed {len(engine.fused_configs)} configs via plans",
+              file=sys.stderr, flush=True)
+        t0 = time.time()
+        grid = engine.run_grid(CONFIGS)
+        t_scores = time.time() - t0
+        pairs = [(keys, grid[keys]) for keys in CONFIGS]
+    else:
+        seen = set()
+        for unit in groups():
+            shape = (unit[0][1], unit[0][4], len(unit))
+            if shape not in seen:
+                run_unit(unit)
+                seen.add(shape)
+                print(f"warmed {shape}", file=sys.stderr, flush=True)
+        t0 = time.time()
+        pairs = []
+        for unit in groups():
+            pairs.extend(zip(unit, run_unit(unit)))
+        t_scores = time.time() - t0
 
-    t0 = time.time()
     t_fit = t_pred = 0.0
     per_config = {}
-    for unit in groups():
-        for keys, res in zip(unit, run_unit(unit)):
-            t_fit += res[0] * engine.n_folds
-            t_pred += res[1] * engine.n_folds
-            # Per-stage walls per config (round 5): gate tolerances can be
-            # per-stage, and a predict regression is no longer hidden
-            # under a fit-dominated total. Fused runs land the combined
-            # wall in "fit" with predict 0.0 (SweepEngine fused mode).
-            per_config["/".join(keys)] = {
-                "fit": round(res[0] * engine.n_folds, 3),
-                "predict": round(res[1] * engine.n_folds, 3),
-                "total": round((res[0] + res[1]) * engine.n_folds, 3),
-            }
-    t_scores = time.time() - t0
+    for keys, res in pairs:
+        t_fit += res[0] * engine.n_folds
+        t_pred += res[1] * engine.n_folds
+        # Per-stage walls per config (round 5): gate tolerances can be
+        # per-stage, and a predict regression is no longer hidden
+        # under a fit-dominated total. Fused runs (and planner-mode
+        # plans) land the combined wall in "fit" with predict 0.0
+        # (SweepEngine fused mode / run_plan).
+        per_config["/".join(keys)] = {
+            "fit": round(res[0] * engine.n_folds, 3),
+            "predict": round(res[1] * engine.n_folds, 3),
+            "total": round((res[0] + res[1]) * engine.n_folds, 3),
+        }
     # Analytic flop count of the probe's fit stage (trees.fit_stage_flops —
     # the same model `report --attrib` splits fit sub-stages with). Round 7's
     # fit_gflops gate metric = this total over the measured fit wall: a
@@ -386,6 +410,7 @@ def worker(n_tests, n_trees):
         "fit_flops": fit_flops,
         "per_config_s": per_config, "n_tests": n_tests, "n_trees": n_trees,
         "bench_fused": engine.fused, "bench_batch": batch_n,
+        "bench_plan": engine.planner_mode,
         "dispatch_trees": DISPATCH_TREES, "backend": jax.default_backend(),
     }), flush=True)
 
@@ -432,6 +457,47 @@ def worker(n_tests, n_trees):
     print(json.dumps({"stage": "journal", **journal_rec,
                       "t_fit": round(t_fit, 3)}), flush=True)
 
+    # Dispatch census (ISSUE 12): fresh XLA dispatches for a WHOLE-GRID
+    # scores run under the planner — the engine-tax metric the planner
+    # exists to bound (<= #families + O(1); 6 plans cover all 216
+    # configs). The count is structural — one instrumented device call
+    # per plan (obs/aot.dispatch_stats), independent of shape or backend
+    # — so it is measured at a tiny shape (fast, compile-cheap) and on
+    # the CPU backend only: 6 extra family compiles over the TPU tunnel
+    # would eat the worker timeout without changing the number. Warm
+    # run_grid first (compiles excluded), then delta the census around a
+    # second full-grid run.
+    dispatch_rec = {}
+    if engine.planner_mode and jax.default_backend() == "cpu":
+        from flake16_framework_tpu.obs import aot as _aot
+        from flake16_framework_tpu.parallel import planner as _planner
+
+        g_trees = int(os.environ.get("BENCH_DISPATCH_GRID_TREES", "2"))
+        g_data = make_data(120)
+        g_engine = sweep.SweepEngine(
+            *g_data, max_depth=8,
+            tree_overrides={"Random Forest": g_trees,
+                            "Extra Trees": g_trees},
+            fused=engine.fused, planner_mode=True)
+        g_engine.run_grid()  # warm: one compile per family plan
+        before = _aot.dispatch_stats()
+        g_engine.run_grid()
+        after = _aot.dispatch_stats()
+        n_plans = len(_planner.plan_grid(
+            cfg.iter_config_keys(), n=len(g_data[0]),
+            n_folds=g_engine.n_folds,
+            tree_overrides=g_engine.tree_overrides))
+        dispatch_rec = {
+            "grid_dispatch_count": after["dispatches"]
+            - before["dispatches"],
+            "grid_dispatch_compiles": after["compiles"]
+            - before["compiles"],
+            "grid_plans": n_plans,
+            "grid_configs": len(list(cfg.iter_config_keys())),
+        }
+        print(json.dumps({"stage": "dispatch", **dispatch_rec}),
+              flush=True)
+
     # SHAP stage. Default impl "auto" = the Pallas kernel on TPU, XLA
     # elsewhere; BENCH_SHAP_IMPL overrides so a hardware A/B (hw_probe
     # tune_shap's xla arm) can ship its winner without a code change.
@@ -466,11 +532,13 @@ def worker(n_tests, n_trees):
         "t_fit": round(t_fit, 3), "t_predict": round(t_pred, 3),
         "fit_flops": fit_flops,
         **journal_rec,
+        **dispatch_rec,
         "per_config_s": per_config,
         "per_config_shap_s": per_config_shap,
         "dispatch_trees": DISPATCH_TREES,
         "bench_batch": batch_n,
         "bench_fused": engine.fused,
+        "bench_plan": engine.planner_mode,
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -891,6 +959,12 @@ def main():
         dispatch_trees=result.get("dispatch_trees"),
         bench_batch=result.get("bench_batch"),
         bench_fused=result.get("bench_fused"),
+        bench_plan=result.get("bench_plan"),
+        # Engine-tax census (round 8+, ISSUE 12): instrumented XLA
+        # dispatches for a whole-216-grid planner scores run — gated
+        # lower-is-better from BENCH_r08 on (tools/bench_gate.py).
+        grid_dispatch_count=result.get("grid_dispatch_count"),
+        grid_plans=result.get("grid_plans"),
         # Crash-tolerance costs (ISSUE 11): fsync'd journal appends as a
         # fraction of the fit wall (acceptance bound <= 2%) and the
         # replay wall a preempted run pays before its first dispatch.

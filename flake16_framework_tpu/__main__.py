@@ -32,7 +32,8 @@ plus extension verbs the reference lacks:
         # close -> in-flight complete -> queued requests get a
         # RETRIABLE rejection -> registry/AOT-manifest flush, with a
         # --drain-deadline that escalates to checkpoint-and-abort
-    python -m flake16_framework_tpu resume [lopo] [fused] [dispatch=N]
+    python -m flake16_framework_tpu resume [lopo] [fused] [planner]
+        [dispatch=N]
         # continue a preempted `scores` sweep from its write-ahead
         # journal (<scores.pkl>.journal; fold-granular, fsync'd):
         # completed configs and folds replay, only unfinished
@@ -104,6 +105,11 @@ def main(argv=None):
                 # one device dispatch per config/batch (TPU round-trip
                 # amortization — SweepEngine fused mode)
                 kw["fused"] = True
+            elif a == "planner":
+                # planner/executor sweep (ISSUE 12): one fused program
+                # per model-family plan, whole grid in <= #families +
+                # O(1) dispatches (parallel/planner.py)
+                kw["planner"] = True
             else:
                 raise ValueError(f"Unrecognized scores option {a!r}")
         write_scores(**kw)
@@ -131,6 +137,8 @@ def main(argv=None):
                 kw["dispatch_trees"] = int(a.split("=", 1)[1]) or None
             elif a == "fused":
                 kw["fused"] = True
+            elif a == "planner":
+                kw["planner"] = True
             else:
                 raise ValueError(f"Unrecognized resume option {a!r}")
         out_file = (LOPO_SCORES_FILE if kw.get("cv") == "lopo"

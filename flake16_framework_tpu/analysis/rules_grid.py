@@ -32,6 +32,14 @@ Checks, each its own rule id:
   (``F16_ENSEMBLE_GROWER=hsit``) fails the pre-flight in seconds on the
   host instead of silently running the wrong tier for hours (the ISSUE-9
   grower knobs are exactly such model-changing switches).
+- G107 executor-scope dispatch loop (per-module, ISSUE 12): a Python
+  ``for``/``while`` loop that calls ``run_config`` inside a function
+  marked ``@executor_scope`` (parallel/sweep.py) re-introduces the
+  per-config dispatch round-trip the planner/executor exists to delete
+  — the exact anti-pattern behind the BENCH_r07 regression (one
+  dispatch per config x fold instead of one program per family plan).
+  Executor-scope functions must dispatch BATCHES; per-config fallback
+  belongs outside the scope (run_grid's guard-salvage tier).
 
 ``preflight_grid`` is callable with injected axes so tests (and future
 config loaders) can validate a candidate grid without editing config.py.
@@ -58,6 +66,9 @@ RULES = {r.id: r for r in (
     RuleInfo("G106", ERROR,
              "env knob census: undeclared F16_* read, stale registry"
              " entry, or invalid knob value in the current environment"),
+    RuleInfo("G107", WARNING,
+             "per-config dispatch loop inside @executor_scope — the"
+             " planner/executor's whole-plan program replaced this"),
 )}
 
 # The declared F16_* knob registry (G106): name -> (kind, detail).
@@ -288,6 +299,65 @@ def preflight_knob_values(environ=None):
             "G106", f"env knob {name}={raw!r} is invalid (want {want}) — "
             "the run would crash at import or silently run a wrong arm",
             path="flake16_framework_tpu/analysis/rules_grid.py"))
+    return findings
+
+
+def _is_executor_scope(fn, aliases):
+    """True when ``fn`` carries the ``@executor_scope`` marker
+    (parallel/sweep.py), under any import alias."""
+    from flake16_framework_tpu.analysis.rules_jax import _dotted
+
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dotted = _dotted(target, aliases)
+        if dotted and (dotted == "executor_scope"
+                       or dotted.endswith(".executor_scope")):
+            return True
+    return False
+
+
+def check_module(mod):
+    """G107: per-config Python-loop device dispatch inside executor
+    scope. ``@executor_scope`` (parallel/sweep.py) marks the functions
+    whose contract is batched whole-plan dispatch; a ``run_config`` call
+    under a ``for``/``while`` in one of them is the per-config
+    round-trip anti-pattern this scope exists to exclude."""
+    from flake16_framework_tpu.analysis.rules_jax import _import_aliases
+
+    if mod.tree is None:
+        return []
+    aliases = _import_aliases(mod.tree)
+    findings = []
+    seen = set()
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _is_executor_scope(fn, aliases):
+            continue
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for call in ast.walk(loop):
+                if not (isinstance(call, ast.Call)
+                        and (call.func.attr if isinstance(
+                            call.func, ast.Attribute)
+                            else call.func.id if isinstance(
+                                call.func, ast.Name) else None)
+                        == "run_config"):
+                    continue
+                site = (call.lineno, call.col_offset)
+                if site in seen:  # nested loops re-walk inner calls
+                    continue
+                seen.add(site)
+                findings.append(Finding(
+                    "G107", RULES["G107"].severity, normpath(mod.path),
+                    call.lineno, call.col_offset,
+                    f"run_config called in a loop inside @executor_scope "
+                    f"function {fn.name!r} — one device round-trip per "
+                    "config is the engine tax the planner deletes; "
+                    "dispatch the whole plan (run_plan) or move the "
+                    "per-config fallback outside executor scope",
+                    snippet="run_config"))
     return findings
 
 

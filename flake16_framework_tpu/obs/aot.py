@@ -46,6 +46,16 @@ _CACHE_EVENTS = {"hits": 0, "misses": 0}
 _HIT_EVENT = "/jax/compilation_cache/cache_hits"
 _MISS_EVENT = "/jax/compilation_cache/cache_misses"
 
+# Every instrumented device-program call bumps "dispatches" (and every
+# fresh AOT compile "compiles") — two plain int adds, cheap enough to
+# stay on even with telemetry off. Since all of the sweep's jitted entry
+# points are instrumented (parallel/sweep.py make_cv_fns / _shard_jit /
+# make_plan_fn), a delta of ``dispatch_stats()`` around a whole-grid
+# ``scores`` run IS its XLA dispatch count — the engine-tax metric
+# bench.py gates as ``grid_dispatch_count`` (ISSUE 12: the planner must
+# keep the whole grid at <= #families + O(1) dispatches).
+_DISPATCH_STATS = {"dispatches": 0, "compiles": 0}
+
 
 def _cache_listener(event, *args, **kw):
     if event == _HIT_EVENT:
@@ -74,6 +84,14 @@ def cache_stats():
     """Aggregate persistent-compilation-cache hits/misses observed by this
     process (both jit and AOT compiles emit them)."""
     return dict(_CACHE_EVENTS)
+
+
+def dispatch_stats():
+    """{"dispatches", "compiles"} counted across every instrumented
+    callable in this process (see _DISPATCH_STATS). Callers measure a
+    code region by delta: ``before = dispatch_stats(); ...;
+    n = dispatch_stats()["dispatches"] - before["dispatches"]``."""
+    return dict(_DISPATCH_STATS)
 
 
 def _cost_totals(compiled):
@@ -154,6 +172,7 @@ class AotExecutableCache:
         return tuple(parts)
 
     def _compile(self, args, kwargs):
+        _DISPATCH_STATS["compiles"] += 1
         t0 = time.perf_counter()
         lowered = self._jfn.lower(*args, **kwargs)
         t1 = time.perf_counter()
@@ -194,6 +213,11 @@ class AotExecutableCache:
         return sig
 
     def __call__(self, *args, **kwargs):
+        # Counted BEFORE the telemetry gate: the dispatch census
+        # (dispatch_stats) must see every device-program call whether or
+        # not F16_TELEMETRY is set — bench's grid_dispatch_count runs
+        # with telemetry off.
+        _DISPATCH_STATS["dispatches"] += 1
         if self._gate and core._state is None:
             return self._jfn(*args, **kwargs)
         sig = self.signature(args, kwargs)

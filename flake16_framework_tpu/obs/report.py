@@ -157,7 +157,8 @@ def summarize(manifest, events):
 
 def summarize_attrib(manifest, events):
     """The ``--attrib`` view: per-config stage walls joined to kernel
-    costs. Span events carry ``stage`` (fit | predict | fused | shap) and
+    costs. Span events carry ``stage`` (fit | predict | fused | plan |
+    shap) and
     either ``config`` or (batch spans) ``configs``; batch walls are split
     evenly across the batch's members — the engine's documented
     amortized-clock convention (SweepEngine.run_config_batch). Sub-stage

@@ -1365,6 +1365,22 @@ def predict(forest, x):
     return p[:, 1] > p[:, 0]
 
 
+def predict_batch(forests, x):
+    """Batched ``predict``: a Forest whose leaves carry ONE extra leading
+    batch axis (the sweep's per-fold forests, ``[folds, T, ...]``)
+    evaluated against a shared matrix — returns ``[batch, N]`` bool.
+
+    The explicit batched entry point of the planner/executor rework
+    (ISSUE 12): the sweep's score closures (parallel/sweep.py score_one /
+    score_folds_one) consume it, and the plan programs vmap it again
+    over the config axis — so the fold-axis predict batching is owned
+    here, next to the traversal kernels, instead of re-derived at every
+    call site. Composes under further vmap/shard_map like any jax
+    function; per-row results are bit-identical to ``predict`` on the
+    corresponding un-batched Forest."""
+    return jax.vmap(lambda f: predict(f, x))(forests)
+
+
 # Cost attribution (obs/costs.py): host-level dispatches of the grower and
 # predict entry points emit ``cost`` events; calls from inside an enclosing
 # jit trace (the sweep's fused programs) pass through untouched. The hist

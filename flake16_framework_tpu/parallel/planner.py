@@ -1,0 +1,162 @@
+"""The sweep planner: the 216-config grid as a handful of execution plans.
+
+PR 9 made the fit kernel 8x faster and the headline bench SLOWER
+(BENCH_r07 vs r05): per-config dispatch round-trips and engine
+bookkeeping — not compute — dominate once the kernel is fast. The fix is
+the structure XGBoost's GPU stack (PAPERS.md, arXiv 1806.11248) and RFX
+(arXiv 2511.19493) both converged on: batch the whole grid into a few
+uniform device programs. This module is the HOST half of that split —
+pure grid arithmetic, no jax import, so plan tables are printable
+(tools/prof_fit.py) without touching a device:
+
+- ``plan_grid(configs, devices=...)`` groups configs by (model family,
+  shape signature) into ``Plan``s. A family — (feature set, model) — is
+  the compile-time axis: within one, flaky type / preprocessing /
+  balancing are runtime int codes, so ONE jit-compiled program covers
+  every member (parallel/sweep.py module docstring). The shape signature
+  (n, n_feat, n_trees, n_folds, cap) rides along as an explicit group
+  key so a future heterogeneous grid splits cleanly instead of padding
+  across shapes.
+- Each plan is padded to a batch width that is a multiple of the device
+  count (``pad_to``), with the pad slots filled by repeating the plan's
+  first config — the executor (SweepEngine.run_plan) masks them out on
+  the host, so padding changes wall-clock waste, never results. The
+  waste is visible up front: ``plan_table``.
+
+Determinism contract (tests/test_planner.py): the same config set yields
+the same plans regardless of input order — members sort by their
+canonical grid index (config.iter_config_keys(), the same order that
+seeds per-config RNG), plans by their first member's index. Plans also
+carry those canonical indices so the executor never re-derives them with
+an O(grid) ``.index()`` per config (the old run_config_batch did).
+"""
+
+from flake16_framework_tpu import config as cfg
+
+
+def canonical_indices():
+    """{config_keys: canonical grid index} — the iter_config_keys() order
+    that seeds per-config RNG (sweep.run_config) and addresses fault
+    injection (resilience/inject.py)."""
+    return {tuple(k): i for i, k in enumerate(cfg.iter_config_keys())}
+
+
+class Plan:
+    """One executable unit: same-family configs, padded to a uniform
+    batch, run as ONE fused device program by SweepEngine.run_plan.
+
+    - ``family``   — (feature_set, model) — the compile-time identity
+    - ``configs``  — member config keys, canonical grid order
+    - ``indices``  — their canonical grid indices (RNG / injection ids)
+    - ``shape``    — (n, n_feat, n_trees, n_folds, cap) signature
+    - ``batch``    — padded width (``pad_to``-aligned); ``pad`` slots of
+      it repeat ``configs[0]`` and are masked out of every result
+    """
+
+    def __init__(self, family, configs, indices, shape, pad_to=1):
+        self.family = tuple(family)
+        self.configs = tuple(tuple(k) for k in configs)
+        self.indices = tuple(int(i) for i in indices)
+        self.shape = tuple(shape)
+        self.pad_to = max(1, int(pad_to))
+        self.batch = -(-len(self.configs) // self.pad_to) * self.pad_to
+        self.pad = self.batch - len(self.configs)
+
+    @property
+    def padded_configs(self):
+        """The device batch: members then pad repeats of the first."""
+        return self.configs + (self.configs[0],) * self.pad
+
+    @property
+    def padded_indices(self):
+        return self.indices + (self.indices[0],) * self.pad
+
+    @property
+    def mask(self):
+        """Validity of each batch slot (False = pad)."""
+        return (True,) * len(self.configs) + (False,) * self.pad
+
+    @property
+    def pad_waste_pct(self):
+        return 100.0 * self.pad / self.batch
+
+    def __repr__(self):
+        return (f"Plan({'/'.join(self.family)}: {len(self.configs)} cfg "
+                f"-> batch {self.batch}, shape {self.shape})")
+
+
+def plan_shape(fs_name, model_name, *, n, n_folds, tree_overrides=None):
+    """The (n, n_feat, n_trees, n_folds, cap) signature one family's
+    program is compiled for. ``cap`` mirrors _make_config_fns' resample
+    bound (SMOTE at worst doubles the training set)."""
+    n_trees = cfg.MODELS[model_name].n_trees
+    if tree_overrides and model_name in tree_overrides:
+        n_trees = tree_overrides[model_name]
+    return (int(n), len(cfg.FEATURE_SETS[fs_name]), int(n_trees),
+            int(n_folds), 2 * int(n))
+
+
+def plan_grid(configs, *, devices=1, n, n_folds, tree_overrides=None):
+    """Group ``configs`` into Plans: one per (family, shape signature),
+    members in canonical grid order, padded to a multiple of ``devices``.
+    Order-independent: any permutation of ``configs`` yields identical
+    plans. Configs outside the canonical grid are a caller bug and raise
+    (their RNG index — hence their results — would be undefined)."""
+    index_of = canonical_indices()
+    seen = set()
+    members = []
+    for keys in configs:
+        keys = tuple(keys)
+        if keys not in index_of:
+            raise ValueError(f"config {keys!r} is not in the 216-config "
+                             f"grid; the planner cannot seed its RNG")
+        if keys in seen:
+            continue
+        seen.add(keys)
+        members.append(keys)
+    members.sort(key=index_of.__getitem__)
+
+    groups = {}
+    for keys in members:
+        family = (keys[1], keys[4])
+        shape = plan_shape(*family, n=n, n_folds=n_folds,
+                           tree_overrides=tree_overrides)
+        groups.setdefault((family, shape), []).append(keys)
+    plans = [
+        Plan(family, group, [index_of[k] for k in group], shape,
+             pad_to=devices)
+        for (family, shape), group in groups.items()
+    ]
+    plans.sort(key=lambda p: p.indices[0])
+    return plans
+
+
+def plan_table(plans):
+    """Rows for the pre-run padding report (tools/prof_fit.py): family,
+    member count, padded batch/shape, pad waste."""
+    return [{
+        "family": "/".join(p.family),
+        "configs": len(p.configs),
+        "batch": p.batch,
+        "padded_shape": list(p.shape),
+        "pad": p.pad,
+        "pad_waste_pct": round(p.pad_waste_pct, 2),
+    } for p in plans]
+
+
+def format_plan_table(plans):
+    """The table as printable lines (one header + one per plan)."""
+    rows = plan_table(plans)
+    head = (f"{'family':<28} {'configs':>7} {'batch':>5} {'pad':>4} "
+            f"{'waste%':>6}  shape (n, n_feat, trees, folds, cap)")
+    lines = [head]
+    for r in rows:
+        lines.append(
+            f"{r['family']:<28} {r['configs']:>7} {r['batch']:>5} "
+            f"{r['pad']:>4} {r['pad_waste_pct']:>6.1f}  "
+            f"{tuple(r['padded_shape'])}")
+    total = sum(r["configs"] for r in rows)
+    dispatches = len(rows)
+    lines.append(f"{total} config(s) -> {dispatches} plan(s) = "
+                 f"{dispatches} whole-grid fit dispatch(es)")
+    return lines

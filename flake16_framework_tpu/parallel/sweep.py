@@ -18,6 +18,19 @@ same pipeline is a pure function of arrays:
 
 Fit and predict run as two jitted stages so the reference's per-config
 T_TRAIN/T_TEST timing fields (experiment.py:468-474) stay measurable.
+
+ISSUE 12 splits the engine into an explicit PLANNER + EXECUTOR on top of
+these building blocks: parallel/planner.py groups the grid into plans
+(one per family, padded to a device-aligned batch with validity masks)
+and ``SweepEngine.run_plan`` executes each as ONE jit-compiled program
+fusing resample -> fit -> predict -> metrics for all folds and all
+member configs (make_plan_fn), returning per-fold counts so the
+write-ahead journal keeps its fold-granular restart quantum. A whole-grid
+``scores`` run is then <= #families + O(1) XLA dispatches (bench.py
+measures this as ``grid_dispatch_count``) instead of hundreds of
+per-config round-trips — the engine tax PR 9's fast kernel exposed
+(BENCH_r07 regression analysis, ROADMAP item 1). The per-config staged/
+chunked paths remain as the resume, salvage, and fault-injection tiers.
 """
 
 import os
@@ -34,12 +47,25 @@ from flake16_framework_tpu.ops.metrics import confusion_by_project, format_score
 from flake16_framework_tpu.ops.preprocess import fit_preprocess, transform
 from flake16_framework_tpu.ops.resample import resample
 from flake16_framework_tpu.ops import trees
+from flake16_framework_tpu.parallel import planner
 from flake16_framework_tpu.parallel.folds import fold_masks, lopo_fold_masks
 from flake16_framework_tpu.resilience import (
     guard as rguard, inject as rinject, ladder as rladder,
 )
 
 N_FOLDS = 10
+
+
+def executor_scope(fn):
+    """Marks plan-executor scope for f16lint's G107 rule
+    (analysis/rules_grid.py): inside these functions a Python loop that
+    dispatches per config (e.g. ``run_config`` per iteration) is the
+    exact anti-pattern the planner/executor split deletes — configs must
+    ride a batch axis of ONE device program instead. Host-side loops over
+    results (journal records, score formatting) are fine and don't match
+    the rule. No-op at runtime."""
+    fn.__f16_executor_scope__ = True
+    return fn
 
 
 def _auto_tree_chunk(spec, n_folds, tree_chunk, use_hist):
@@ -194,7 +220,7 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
         return jax.vmap(f)(xs, ys, ws, tks)
 
     def score_one(forest, xp, y, test_mask, project_ids):
-        preds = jax.vmap(lambda f: trees.predict(f, xp))(forest)
+        preds = trees.predict_batch(forest, xp)  # fold-axis batched entry
         return confusion_by_project(
             y, preds, test_mask, project_ids, n_projects
         )
@@ -205,13 +231,12 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
         are int32 and fold-additive, so summing over axis 0 reproduces
         ``score_one``'s totals bit-exactly — which is what makes the fold
         the journal's restart quantum."""
-        def per_fold(f, tm):
-            preds = trees.predict(f, xp)
-            return confusion_by_project(
-                y, preds, tm, project_ids, n_projects
+        preds = trees.predict_batch(forest, xp)  # [m, N] fold-axis batch
+        return jax.vmap(
+            lambda p, tm: confusion_by_project(
+                y, p, tm, project_ids, n_projects
             )
-
-        return jax.vmap(per_fold)(forest, test_mask)
+        )(preds, test_mask)
 
     def run_all_one(x, y_raw, flaky_label, prep_code, bal_code, key,
                     train_mask, test_mask, project_ids):
@@ -229,8 +254,21 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
                                 key, train_mask)
         return score_one(forest, xp, y, test_mask, project_ids)
 
+    def run_all_folds_one(x, y_raw, flaky_label, prep_code, bal_code, key,
+                          train_mask, test_mask, project_ids):
+        """``run_all_one`` keeping the fold axis: the planner/executor's
+        unit (make_plan_fn) — ONE program returning per-fold counts
+        [n_folds, P, 3]. Counts are int32 and fold-additive, so summing
+        axis 0 reproduces ``run_all_one``'s totals bit-exactly while the
+        per-fold rows let the write-ahead journal keep its fold-granular
+        restart quantum under whole-plan execution."""
+        forest, xp, y = fit_one(x, y_raw, flaky_label, prep_code, bal_code,
+                                key, train_mask)
+        return score_folds_one(forest, xp, y, test_mask, project_ids)
+
     return (fit_one, score_one, prep_resample_one, fit_trees_chunk,
-            tree_keys_one, run_all_one, fit_folds_one, score_folds_one)
+            tree_keys_one, run_all_one, fit_folds_one, score_folds_one,
+            run_all_folds_one)
 
 
 def _fit_cost_fields(spec, *, n, n_feat, cap, n_folds, grower):
@@ -268,9 +306,11 @@ def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
     (n, n_feat, spec) so each family compiles exactly once.
 
     Returns (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys,
-    cv_all, cv_fit_folds, cv_score_folds); the last two are the
-    journal-resume pair (explicit fold subsets / per-fold counts — see
-    _make_config_fns). cv_prep/cv_fit_chunk/cv_tree_keys drive the
+    cv_all, cv_fit_folds, cv_score_folds, cv_plan_one);
+    cv_fit_folds/cv_score_folds are the journal-resume pair (explicit
+    fold subsets / per-fold counts — see _make_config_fns) and
+    cv_plan_one is the fused per-fold program the planner's batched
+    executor vmaps (make_plan_fn). cv_prep/cv_fit_chunk/cv_tree_keys drive the
     dispatch-chunked
     fit (SweepEngine.run_config with ``dispatch_trees``): one prep+resample
     dispatch, then one bounded fit dispatch per tree-key slice (compiled
@@ -290,14 +330,35 @@ def make_cv_fns(spec, *, n, n_feat, n_projects, cap=None, max_depth=48,
                                   n_folds=n_folds, grower=grower)
     names = ("scores.fit", "scores.score", "scores.prep",
              "scores.fit_chunk", "scores.tree_keys", "scores.config",
-             "scores.fit_folds", "scores.score_folds")
+             "scores.fit_folds", "scores.score_folds", "scores.plan_one")
     carries_fit = {"scores.fit", "scores.fit_chunk", "scores.config",
-                   "scores.fit_folds"}
+                   "scores.fit_folds", "scores.plan_one"}
     return tuple(
         costs.instrument(jax.jit(f), nm,
                          cost_fields=fit_fields if nm in carries_fit
                          else None)
         for f, nm in zip(fns, names))
+
+
+def _shard_jit(mesh, f, in_specs, out_specs, name, cost_fields=None):
+    """shard_map + jit + cost instrumentation — the wrapper every mesh
+    entry point (make_sharded_cv_fns, make_plan_fn) shares. Replicated
+    data arrays mix with config-varying codes inside lax.switch; jax
+    0.9's varying-manual-axes validator rejects that conservatively (its
+    own error message says to disable), hence check_vma=False."""
+    try:
+        sm = jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+    except AttributeError:
+        # jax < 0.6 ships shard_map under experimental, with the
+        # validator knob spelled check_rep instead of check_vma.
+        from jax.experimental.shard_map import shard_map as shard_map_fn
+
+        sm = shard_map_fn(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+    # ``name`` tags the SPMD program's compile-cost events (obs/costs.py)
+    # with the kernel it serves.
+    return costs.instrument(jax.jit(sm), name, cost_fields=cost_fields)
 
 
 def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
@@ -326,7 +387,8 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
     mesh "config" axis size; within a shard, configs ride a vmap axis.
     """
     (fit_one, score_one, prep_resample_one, fit_trees_chunk,
-     tree_keys_one, run_all_one, _fit_folds_one, score_folds_one) = \
+     tree_keys_one, run_all_one, _fit_folds_one, score_folds_one,
+     _run_all_folds_one) = \
         _make_config_fns(
             spec, n=n, n_projects=n_projects, max_depth=max_depth,
             n_folds=n_folds, tree_chunk=tree_chunk, grower=grower,
@@ -378,47 +440,96 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
     forest_specs = jax.tree.map(lambda _: pspec, trees.Forest(
         *[0] * len(trees.Forest._fields)
     ))
-    # Replicated data arrays mix with config-varying codes inside
-    # lax.switch; jax 0.9's varying-manual-axes validator rejects
-    # that conservatively (its own error message says to disable).
-    def smap(f, in_specs, out_specs, name, cost_fields=None):
-        try:
-            sm = jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                               out_specs=out_specs, check_vma=False)
-        except AttributeError:
-            # jax < 0.6 ships shard_map under experimental, with the
-            # validator knob spelled check_rep instead of check_vma.
-            from jax.experimental.shard_map import shard_map as shard_map_fn
+    fit_fields = _fit_cost_fields(spec, n=n, n_feat=n_feat, cap=None,
+                                  n_folds=n_folds, grower=grower)
+    fit_b = _shard_jit(mesh, fit_batch,
+                       (P(), P(), pspec, pspec, pspec, pspec, pspec),
+                       (forest_specs, pspec, pspec), "scores.fit_batch",
+                       cost_fields=fit_fields)
+    prep_b = _shard_jit(mesh, prep_batch,
+                        (P(), P(), pspec, pspec, pspec, pspec, pspec),
+                        (pspec, pspec, pspec, pspec, pspec, pspec),
+                        "scores.prep_batch")
+    fit_chunk_b = _shard_jit(mesh, fit_chunk_batch,
+                             (pspec, pspec, pspec, pspec, pspec),
+                             forest_specs, "scores.fit_chunk_batch",
+                             cost_fields=fit_fields)
+    tree_keys_b = _shard_jit(mesh, tree_keys_batch, (pspec,), pspec,
+                             "scores.tree_keys_batch")
+    score_b = _shard_jit(mesh, score_batch,
+                         (forest_specs, pspec, pspec, pspec, P()),
+                         pspec, "scores.score_batch")
+    score_folds_b = _shard_jit(mesh, score_folds_batch,
+                               (forest_specs, pspec, pspec, pspec, P()),
+                               pspec, "scores.score_folds_batch")
+    all_b = _shard_jit(mesh, all_batch,
+                       (P(), P(), pspec, pspec, pspec, pspec, pspec,
+                        pspec, P()), pspec, "scores.config_batch",
+                       cost_fields=fit_fields)
+    return (fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b, all_b,
+            score_folds_b)
 
-            sm = shard_map_fn(f, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs, check_rep=False)
-        # ``name`` tags the SPMD program's compile-cost events
-        # (obs/costs.py) with the kernel it serves.
-        return costs.instrument(jax.jit(sm), name, cost_fields=cost_fields)
+
+def make_plan_fn(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
+                 n_folds=N_FOLDS, grower=None):
+    """ONE whole-plan program — the planner's executor kernel: the fused
+    per-config CV pipeline (run_all_folds_one: preprocess -> resample ->
+    fit -> predict -> confusion) mapped over the plan's padded config
+    batch, shard_mapped over the mesh "config" axis when one is given
+    (config-axis data parallelism; within a shard configs ride the vmap
+    axis).
+
+    Without a mesh the batch rides ``lax.map`` — still ONE compile and
+    ONE dispatch per plan, but members keep their OWN dynamic trip
+    counts. This matters: the grower's node-batched BFS is a while_loop,
+    and under vmap every member runs for the batch MAX trip count, so a
+    plan costs batch x worst-member — measured 17.7 s whole-bench fit
+    (vmap) vs ~14 s (lax.map) on the 1-core CPU bench, where lockstep
+    buys no parallelism (PROFILE.md "Planner/executor"). On a mesh the
+    vmap layout is kept: lockstep is the price of cross-config MXU
+    batching, and devices run members concurrently.
+
+    Returns per-FOLD counts [B, n_folds, P, 3]: the fold axis keeps the
+    write-ahead journal fold-granular under family-batched execution
+    (summing it reproduces config totals bit-exactly — int32 fold
+    additivity, score_folds_one), and the executor (SweepEngine.run_plan)
+    drops the padded tail on the host via the plan's validity mask. One
+    compile per (family, batch width); a whole-grid sweep is then
+    #families dispatches of this program plus O(1) host work."""
+    fns = _make_config_fns(
+        spec, n=n, n_projects=n_projects, max_depth=max_depth,
+        n_folds=n_folds, grower=grower,
+    )
+    run_all_folds_one = fns[8]
+
+    def plan_batch(x, y_raw, fls, preps, bals, keys, train_masks,
+                   test_masks, project_ids):
+        return jax.vmap(
+            lambda fl, prep, bal, key, trm, tem: run_all_folds_one(
+                x, y_raw, fl, prep, bal, key, trm, tem, project_ids
+            )
+        )(fls, preps, bals, keys, train_masks, test_masks)
 
     fit_fields = _fit_cost_fields(spec, n=n, n_feat=n_feat, cap=None,
                                   n_folds=n_folds, grower=grower)
-    fit_b = smap(fit_batch, (P(), P(), pspec, pspec, pspec, pspec, pspec),
-                 (forest_specs, pspec, pspec), "scores.fit_batch",
-                 cost_fields=fit_fields)
-    prep_b = smap(prep_batch, (P(), P(), pspec, pspec, pspec, pspec, pspec),
-                  (pspec, pspec, pspec, pspec, pspec, pspec),
-                  "scores.prep_batch")
-    fit_chunk_b = smap(fit_chunk_batch,
-                       (pspec, pspec, pspec, pspec, pspec), forest_specs,
-                       "scores.fit_chunk_batch", cost_fields=fit_fields)
-    tree_keys_b = smap(tree_keys_batch, (pspec,), pspec,
-                       "scores.tree_keys_batch")
-    score_b = smap(score_batch, (forest_specs, pspec, pspec, pspec, P()),
-                   pspec, "scores.score_batch")
-    score_folds_b = smap(score_folds_batch,
-                         (forest_specs, pspec, pspec, pspec, P()),
-                         pspec, "scores.score_folds_batch")
-    all_b = smap(all_batch, (P(), P(), pspec, pspec, pspec, pspec, pspec,
-                             pspec, P()), pspec, "scores.config_batch",
-                 cost_fields=fit_fields)
-    return (fit_b, score_b, prep_b, fit_chunk_b, tree_keys_b, all_b,
-            score_folds_b)
+    if mesh is None:
+        def plan_batch_serial(x, y_raw, fls, preps, bals, keys,
+                              train_masks, test_masks, project_ids):
+            return jax.lax.map(
+                lambda m: run_all_folds_one(
+                    x, y_raw, m[0], m[1], m[2], m[3], m[4], m[5],
+                    project_ids,
+                ),
+                (fls, preps, bals, keys, train_masks, test_masks),
+            )
+        return costs.instrument(jax.jit(plan_batch_serial),
+                                "scores.plan_batch",
+                                cost_fields=fit_fields)
+    pspec = P("config")
+    return _shard_jit(mesh, plan_batch,
+                      (P(), P(), pspec, pspec, pspec, pspec, pspec, pspec,
+                       P()),
+                      pspec, "scores.plan_batch", cost_fields=fit_fields)
 
 
 def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,
@@ -545,7 +656,7 @@ class SweepEngine:
                  project_ids, *, mesh=None, max_depth=48, seed=0,
                  n_folds=None, tree_overrides=None, cv="stratified",
                  dispatch_trees=None, dispatch_folds=None, grower=None,
-                 fused=False, journal=None):
+                 fused=False, journal=None, planner_mode=False):
         self.features = np.asarray(features, dtype=np.float32)
         self.labels_raw = np.asarray(labels_raw, dtype=np.int32)
         self.projects = projects
@@ -580,6 +691,16 @@ class SweepEngine:
         # to the staged path, which stays the attribution instrument.
         self.fused = fused
         self.fused_configs = set()
+        # planner_mode=True makes run_grid the planner/executor path
+        # (module docstring): configs group into plans
+        # (parallel/planner.py) and each plan runs as ONE fused program
+        # via run_plan — <= #families + O(1) dispatches for the whole
+        # grid. Like ``fused``, plan walls are combined (T_TRAIN carries
+        # the amortized plan wall, T_TEST=0.0) and recorded in
+        # fused_configs/amortized_configs. The per-config paths stay in
+        # service as the journal-resume, guard-salvage, and
+        # device-fault-injection tiers.
+        self.planner_mode = planner_mode
         # Write-ahead journal (resilience/journal.py, ISSUE 11): when
         # attached, every completed fold's counts are fsync'd before the
         # sweep moves on, and run_config resumes partially-journaled
@@ -599,6 +720,7 @@ class SweepEngine:
         self.quarantined = {}
         self._fns = {}
         self._sharded_fns = {}
+        self._plan_fns = {}
         # Fold masks depend on the label vector => per flaky type
         # (reference re-splits per config, experiment.py:449-450; identical
         # within a flaky type). LOPO folds (north-star 26-project CV) depend
@@ -676,7 +798,7 @@ class SweepEngine:
         syncs in timed mode only — see _chunked_fit)."""
         fl_name, fs_name, prep_name, bal_name, model_name = config_keys
         (cv_fit, cv_score, cv_prep, cv_fit_chunk, cv_tree_keys, cv_all,
-         cv_fit_folds, cv_score_folds), \
+         cv_fit_folds, cv_score_folds, _cv_plan_one), \
             cols = self._get_fns(fs_name, model_name)
 
         x = jnp.asarray(self.features[:, cols])
@@ -841,6 +963,104 @@ class SweepEngine:
             )
         return self._sharded_fns[key]
 
+    def _get_plan_fn(self, fs_name, model_name):
+        """The family's whole-plan executor program (make_plan_fn),
+        compiled against this engine's mesh (or single-device vmap when
+        none) — cached like _get_fns/_get_sharded_fns."""
+        key = (fs_name, model_name)
+        if key not in self._plan_fns:
+            n, _ = self.features.shape
+            cols = list(cfg.FEATURE_SETS[fs_name])
+            self._plan_fns[key] = (
+                make_plan_fn(
+                    self._spec(model_name), self.mesh, n=n,
+                    n_feat=len(cols),
+                    n_projects=len(self.project_names),
+                    max_depth=self.max_depth, n_folds=self.n_folds,
+                    grower=self.grower,
+                ),
+                cols,
+            )
+        return self._plan_fns[key]
+
+    @executor_scope
+    def run_plan(self, plan):
+        """Execute one planner Plan (parallel/planner.py) as ONE fused
+        device program and return per-member results in run_config's
+        4-element schema. The program returns per-FOLD counts
+        [B, folds, P, 3]; the padded tail (plan.mask) is dropped on the
+        host, so pad slots cost wall-clock waste (visible in the plan
+        table) but can never leak into results.
+
+        Journal discipline for mid-plan preemption (satellite of ISSUE
+        12): each REAL member's folds are journaled in canonical batch
+        order, then its config record, before the next member's — so a
+        kill at any point leaves a journal whose prefix is: earlier
+        members complete, the in-flight member partial (exactly its
+        fsync'd folds), later members untouched. The resuming run_grid
+        then re-attempts ONLY the masked-out (config, fold) pairs: the
+        partial member resumes per-config at fold granularity
+        (run_config's fold-subset fit), untouched members re-plan.
+        Fold counts are bit-identical across the plan and per-config
+        paths (same closures, same keys — tests/test_planner.py), so
+        the merged totals match an uninterrupted run.
+
+        Clock provenance: plan walls are combined and amortized — the
+        per-member T_TRAIN is plan_wall / len(configs) / n_folds with
+        T_TEST=0.0, members join ``fused_configs`` (and, for multi-member
+        plans, ``amortized_configs``) for the timing-meta sidecar."""
+        fs_name, model_name = plan.family
+        plan_fn, cols = self._get_plan_fn(fs_name, model_name)
+        batch = plan.padded_configs
+
+        fls = np.array([cfg.FLAKY_TYPES[k[0]] for k in batch], np.int32)
+        preps = np.array([cfg.PREPROCESSINGS[k[2]] for k in batch],
+                         np.int32)
+        bals = np.array([cfg.BALANCINGS[k[3]] for k in batch], np.int32)
+        base = jax.random.PRNGKey(self.seed)
+        keys = np.stack([np.asarray(jax.random.fold_in(base, idx))
+                         for idx in plan.padded_indices])
+        trms = np.stack([self._masks[k[0]][0] for k in batch])
+        tems = np.stack([self._masks[k[0]][1] for k in batch])
+        x = jnp.asarray(self.features[:, cols])
+        n_trees = self._spec(model_name).n_trees
+
+        configs_field = ["/".join(k) for k in plan.configs]
+        with obs.span("scores.plan", key=(fs_name, model_name, plan.batch),
+                      stage="plan", batch=len(plan.configs),
+                      pad=plan.pad, configs=configs_field):
+            t0 = time.time()
+            counts_f = np.asarray(plan_fn(  # np.asarray blocks
+                x, jnp.asarray(self.labels_raw), jnp.asarray(fls),
+                jnp.asarray(preps), jnp.asarray(bals), jnp.asarray(keys),
+                jnp.asarray(trms), jnp.asarray(tems),
+                jnp.asarray(self.project_ids),
+            ))
+            wall = (time.time() - t0) / len(plan.configs)
+
+        out = []
+        for i, k in enumerate(plan.configs):  # mask: real members only
+            if self.journal is not None:
+                fkh = np.asarray(jax.random.split(
+                    jnp.asarray(keys[i]), self.n_folds))
+                for f in range(self.n_folds):
+                    self.journal.record_fold(
+                        k, f, fkh[f].tobytes(), counts_f[i, f],
+                        config_index=plan.indices[i])
+            scores, scores_total = format_scores(
+                counts_f[i].sum(axis=0), self.project_names, self.projects
+            )
+            res = [wall / self.n_folds, 0.0, scores, scores_total]
+            if self.journal is not None:
+                self.journal.record_config(k, res)
+            out.append(res)
+        self.fused_configs.update(plan.configs)
+        if len(plan.configs) > 1:
+            self.amortized_configs.update(plan.configs)
+        self._count_done(len(plan.configs), n_trees)
+        return out
+
+    @executor_scope
     def run_config_batch(self, config_batch):
         """Run a batch of same-family configs over the mesh's config axis.
         Returns a list of per-config results in the run_config schema;
@@ -987,7 +1207,14 @@ class SweepEngine:
         overrides the batch width (default: the mesh device count) — on a
         single chip a width >1 still batches configs onto the within-shard
         vmap axis (the BENCH_BATCH mode); leftover singleton batches go
-        through the per-config path."""
+        through the per-config path.
+
+        With ``planner_mode`` the whole call routes through the
+        planner/executor instead (_run_grid_plans): one fused program per
+        family plan, <= #families + O(1) dispatches, with per-config
+        execution retained only for journal resume, guard salvage, and
+        device-fault injection (which needs per-config dispatch
+        granularity — process-signal injection does not)."""
         obs.record_jax_manifest(mesh=self.mesh)
         scores = dict(ledger or {})
         if config_list is None:
@@ -1024,11 +1251,21 @@ class SweepEngine:
 
         b = batch_size if batch_size is not None else (
             self.mesh.devices.size if self.mesh is not None else 1)
-        if plan is not None:
-            # Injection targets (config k, attempt j); the batch path runs
-            # many configs per dispatch, so the fault drill forces the
-            # per-config path to keep config granularity deterministic.
+        device_faults = plan is not None and any(
+            fc not in rinject.PROCESS_CLASSES for _, _, fc in plan.entries)
+        if device_faults:
+            # Injection targets (config k, attempt j); the batched paths
+            # run many configs per dispatch, so a DEVICE-fault drill
+            # forces the per-config path to keep config granularity
+            # deterministic. Process entries (sigkill/sigterm) do NOT
+            # force it: the journal delivers those at fold-append points,
+            # which the plan path hits per (config, fold) as well — the
+            # chaos harness's "SIGKILL inside a family program" case
+            # (tools/chaos_drill.py, plan drill).
             b = 1
+        if self.planner_mode and not device_faults:
+            return self._run_grid_plans(scores, todo, guard, run_guarded,
+                                        progress)
         if self.mesh is None or b <= 1:
             for i, keys in enumerate(todo):
                 res = run_guarded(keys)
@@ -1076,6 +1313,61 @@ class SweepEngine:
                 done += 1
                 if progress is not None:
                     progress(done, len(todo), keys, scores)
+        return scores
+
+    def _run_grid_plans(self, scores, todo, guard, run_guarded, progress):
+        """run_grid's planner/executor path (``planner_mode``): group the
+        remaining configs into plans (parallel/planner.py — one per
+        family, padded to the device count) and execute each as ONE
+        guarded fused program (run_plan). The whole grid is then
+        len(plans) device dispatches plus O(1) host work.
+
+        The per-config path stays in service for exactly two tiers:
+        - journal resume: partially-journaled configs re-attempt ONLY
+          their masked-out folds (run_config's fold-subset fit), which a
+          whole-plan program cannot express — they run first, and only
+          fresh configs are planned;
+        - guard salvage: a plan abandoned by the dispatch guard retries
+          per-config, so one bad member (quarantined alone) cannot
+          poison its plan-mates' scores (tests/test_planner.py)."""
+        done = 0
+        total = len(todo)
+        rest = todo
+        if self.journal is not None:
+            partial = [k for k in todo if self.journal.partial_folds(k)]
+            if partial:
+                rest = [k for k in todo
+                        if not self.journal.partial_folds(k)]
+                for keys in partial:
+                    res = run_guarded(keys)
+                    if res is not None:
+                        scores[keys] = res
+                    done += 1
+                    if progress is not None:
+                        progress(done, total, keys, scores)
+        plans = planner.plan_grid(
+            rest,
+            devices=(self.mesh.devices.size if self.mesh is not None
+                     else 1),
+            n=self.features.shape[0], n_folds=self.n_folds,
+            tree_overrides=self.tree_overrides)
+        for pl in plans:
+            def plan_thunk(pl=pl):
+                with rladder.device_context():
+                    return self.run_plan(pl)
+            try:
+                results = guard.call(plan_thunk,
+                                     label=f"plan/{'/'.join(pl.family)}")
+            except rguard.DispatchAbandoned:
+                # Salvage per-config: one bad config (or one flaky plan
+                # dispatch) must not quarantine its plan-mates.
+                results = [run_guarded(k) for k in pl.configs]
+            for keys, res in zip(pl.configs, results):
+                if res is not None:
+                    scores[keys] = res
+                done += 1
+                if progress is not None:
+                    progress(done, total, keys, scores)
         return scores
 
 

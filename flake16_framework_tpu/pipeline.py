@@ -115,7 +115,7 @@ def write_scores(tests_file=TESTS_FILE, out_file=None, *,
                  checkpoint_every=12, progress_out=sys.stdout,
                  cv="stratified", mesh=None, profile_dir=None,
                  dispatch_trees=None, dispatch_folds=None, fused=False,
-                 journal=True):
+                 journal=True, planner=False):
     """Run the (216-config x 10-fold) sweep and pickle the reference-schema
     scores dict. Resumes from an existing partial ``out_file``.
 
@@ -136,7 +136,15 @@ def write_scores(tests_file=TESTS_FILE, out_file=None, *,
     batched across a "config" mesh axis over ICI; pass ``mesh`` to override
     the default all-local-devices mesh. ``profile_dir`` wraps the sweep in a
     ``jax.profiler.trace`` (the tracing hook the reference lacks —
-    SURVEY.md §5)."""
+    SURVEY.md §5).
+
+    ``planner=True`` routes the sweep through the planner/executor
+    (ISSUE 12, parallel/planner.py): configs group into one plan per
+    model family and each plan runs as ONE fused device program — the
+    whole grid in <= #families + O(1) dispatches. Plan clocks are
+    combined/amortized (recorded in the timing-meta sidecar like
+    ``fused``); the journal stays fold-granular, so a killed planner run
+    resumes exactly its masked-out (config, fold) pairs."""
     if out_file is None:
         out_file = SCORES_FILE if cv == "stratified" else LOPO_SCORES_FILE
     feats, labels, projects, names, pids = _load_arrays(tests_file)
@@ -148,7 +156,7 @@ def write_scores(tests_file=TESTS_FILE, out_file=None, *,
         feats, labels, projects, names, pids, max_depth=max_depth,
         tree_overrides=tree_overrides, cv=cv, mesh=mesh,
         dispatch_trees=dispatch_trees, dispatch_folds=dispatch_folds,
-        fused=fused,
+        fused=fused, planner_mode=planner,
     )
 
     ledger = _load_ledger(out_file)

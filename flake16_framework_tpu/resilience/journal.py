@@ -47,6 +47,19 @@ plan's process entries (resilience/inject.py, ``<config>:<fold>:sigkill``)
 AFTER the fsync and delivers the scheduled signal to its own process —
 the deterministic kill point where the record is durable and everything
 after it is lost.
+
+Planner-mode execution (ISSUE 12) keeps this contract without changing
+the format: a family plan computes all of its members' folds in ONE
+device program, then journals them per real config — each member's
+fold records in fold order, then its config record — before the next
+member's. A SIGKILL inside a family program therefore leaves the same
+journal shape a per-config run would: fully-recorded members replay as
+completed, the in-flight member as a partial fold set, later members as
+absent. On resume, run_grid routes partially-journaled configs through
+the per-config fold-subset path (ONLY their masked-out folds are
+re-fit) and re-plans the rest — so replay re-attempts exactly the
+(config, fold) pairs the kill masked out, never a whole plan
+(tools/chaos_drill.py, ``plan`` drill).
 """
 
 import os

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from flake16_framework_tpu import obs
+from flake16_framework_tpu.parallel.sweep import executor_scope
 from flake16_framework_tpu.serve import hot_path
 
 
@@ -71,6 +72,14 @@ def serve_blocking(y):
 def torn_artifact_write(doc):
     with open("/tmp/artifact.json", "w") as fd:    # expect J701
         fd.write(doc)
+
+
+@executor_scope
+def per_config_loop_in_executor(engine, plan):
+    out = []
+    for keys in plan.configs:
+        out.append(engine.run_config(keys))       # expect G107
+    return out
 
 
 def suppressed_examples(xs):

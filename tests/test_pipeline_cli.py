@@ -158,7 +158,8 @@ def test_cli_scores_option_parsing(monkeypatch):
     seen = {}
     monkeypatch.setattr("flake16_framework_tpu.pipeline.write_scores",
                         lambda **kw: seen.update(kw) or {})
-    cli.main(["scores", "fused", "dispatch=7", "lopo"])
-    assert seen == {"fused": True, "dispatch_trees": 7, "cv": "lopo"}
+    cli.main(["scores", "fused", "dispatch=7", "lopo", "planner"])
+    assert seen == {"fused": True, "dispatch_trees": 7, "cv": "lopo",
+                    "planner": True}
     with pytest.raises(ValueError, match="Unrecognized scores option"):
         cli.main(["scores", "nope"])

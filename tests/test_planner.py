@@ -1,0 +1,223 @@
+"""Planner/executor (ISSUE 12): grid->plan grouping determinism, padded
+whole-plan batch parity vs the per-config engine, dispatch-count budget,
+and quarantine isolation when a plan is salvaged per-config."""
+
+import numpy as np
+import pytest
+
+from flake16_framework_tpu import config as cfg
+from flake16_framework_tpu.parallel import planner, sweep
+from flake16_framework_tpu.utils.synth import make_dataset
+
+N_TESTS = 240
+N_PROJECTS = 6
+
+# One family (NOD/Flake16/Decision Tree): the DT grower is RNG-free and
+# deterministic, so plan-path results must be BIT-identical to the
+# per-config path — any drift is a masking/padding bug, not noise.
+DT_CONFIGS = [
+    ("NOD", "Flake16", "None", "None", "Decision Tree"),
+    ("OD", "Flake16", "Scaling", "None", "Decision Tree"),
+    ("NOD", "Flake16", "PCA", "Tomek Links", "Decision Tree"),
+    ("OD", "Flake16", "None", "SMOTE", "Decision Tree"),
+]
+
+ET_CONFIGS = [
+    ("NOD", "Flake16", "None", "None", "Extra Trees"),
+    ("OD", "Flake16", "Scaling", "SMOTE", "Extra Trees"),
+]
+
+
+def _make_engine(**overrides):
+    feats, labels, pids = make_dataset(
+        n_tests=N_TESTS, n_projects=N_PROJECTS, seed=11)
+    names = [f"project{p:02d}" for p in range(N_PROJECTS)]
+    projects = np.array([names[p] for p in pids])
+    kw = dict(max_depth=24,
+              tree_overrides={"Extra Trees": 4, "Random Forest": 4})
+    kw.update(overrides)
+    return sweep.SweepEngine(feats, labels, projects, names, pids, **kw)
+
+
+@pytest.fixture(scope="module")
+def ref_engine():
+    """Per-config reference — the singles path every plan must match."""
+    return _make_engine()
+
+
+# -- planner: pure host-side grid arithmetic ---------------------------------
+
+
+def test_full_grid_plans_one_per_family():
+    plans = planner.plan_grid(cfg.iter_config_keys(), devices=8,
+                              n=N_TESTS, n_folds=10)
+    assert len(plans) == 6  # 2 feature sets x 3 models
+    assert sum(len(p.configs) for p in plans) == 216
+    assert {p.family for p in plans} == {
+        (fs, m) for fs in cfg.FEATURE_SETS for m in cfg.MODELS}
+    index_of = planner.canonical_indices()
+    for p in plans:
+        # members in canonical grid order, indices consistent with them
+        assert list(p.indices) == sorted(p.indices)
+        assert [index_of[k] for k in p.configs] == list(p.indices)
+        assert p.batch % 8 == 0 and p.batch >= len(p.configs)
+    # plans themselves ordered by first member's canonical index
+    firsts = [p.indices[0] for p in plans]
+    assert firsts == sorted(firsts)
+    # host half stays host-only: plan tables print without a device
+    assert not hasattr(planner, "jax")
+
+
+def test_plan_grid_order_independent():
+    import random
+
+    grid = [tuple(k) for k in cfg.iter_config_keys()]
+    shuffled = list(grid)
+    random.Random(3).shuffle(shuffled)
+    shuffled += grid[:7]  # duplicates must collapse, not double-plan
+
+    def fingerprint(plans):
+        return [(p.family, p.configs, p.indices, p.shape, p.batch)
+                for p in plans]
+
+    a = planner.plan_grid(grid, devices=8, n=N_TESTS, n_folds=10)
+    b = planner.plan_grid(shuffled, devices=8, n=N_TESTS, n_folds=10)
+    assert fingerprint(a) == fingerprint(b)
+
+
+def test_plan_padding_math():
+    plans = planner.plan_grid(DT_CONFIGS[:3], devices=8, n=N_TESTS,
+                              n_folds=10)
+    assert len(plans) == 1
+    p = plans[0]
+    assert (p.batch, p.pad) == (8, 5)
+    assert p.pad_waste_pct == pytest.approx(62.5)
+    assert p.padded_configs[3:] == (p.configs[0],) * 5
+    assert p.mask == (True, True, True) + (False,) * 5
+    # no mesh -> no padding
+    solo = planner.plan_grid(DT_CONFIGS[:3], devices=1, n=N_TESTS,
+                             n_folds=10)[0]
+    assert (solo.batch, solo.pad) == (3, 0)
+
+
+def test_plan_grid_rejects_off_grid_config():
+    with pytest.raises(ValueError, match="not in the 216-config grid"):
+        planner.plan_grid(
+            [("NOD", "Flake16", "None", "None", "Gradient Boosting")],
+            devices=1, n=N_TESTS, n_folds=10)
+
+
+def test_plan_shape_applies_tree_overrides():
+    base = planner.plan_shape("Flake16", "Extra Trees", n=N_TESTS,
+                              n_folds=10)
+    small = planner.plan_shape("Flake16", "Extra Trees", n=N_TESTS,
+                               n_folds=10,
+                               tree_overrides={"Extra Trees": 4})
+    assert base[2] == cfg.MODELS["Extra Trees"].n_trees
+    assert small[2] == 4
+    assert base[4] == small[4] == 2 * N_TESTS  # SMOTE resample cap
+
+
+# -- executor: whole-plan program vs the singles engine ----------------------
+
+
+def test_planner_engine_matches_per_config_dt(ref_engine):
+    from flake16_framework_tpu.obs import aot
+
+    eng = _make_engine(planner_mode=True)
+    scores = eng.run_grid(DT_CONFIGS)
+    assert set(scores) == set(DT_CONFIGS)
+    for keys in DT_CONFIGS:
+        ref = ref_engine.run_config(keys)
+        assert scores[keys][2] == ref[2]
+        assert scores[keys][3] == ref[3]
+        assert len(scores[keys]) == 4  # strict reference value schema
+        # plan clocks are amortized across members; provenance is tracked
+        # on the engine (pipeline.write_scores persists the sidecar)
+        assert keys in eng.fused_configs
+        assert keys in eng.amortized_configs
+    # Dispatch budget (the tentpole's point): a warm whole-set run is ONE
+    # device dispatch per plan — here a single family -> exactly 1.
+    before = aot.dispatch_stats()
+    again = eng.run_grid(DT_CONFIGS)
+    delta = aot.dispatch_stats()["dispatches"] - before["dispatches"]
+    assert delta == 1
+    assert {k: v[2:] for k, v in again.items()} == {
+        k: v[2:] for k, v in scores.items()}
+
+
+def _metrics_close(ours, theirs, atol=0.01):
+    """p/r/f columns within the fast-tier tolerance; None (undefined
+    metric, zero denominator) must agree exactly."""
+    assert len(ours) == len(theirs)
+    for a, b in zip(ours, theirs):
+        if a is None or b is None:
+            assert a == b
+        else:
+            assert a == pytest.approx(b, abs=atol)
+
+
+def test_planner_engine_matches_per_config_et(ref_engine):
+    # RNG family: run_plan derives each member's key from its CANONICAL
+    # grid index (fold_in(seed, index)) exactly like run_config, so even
+    # the resample/tree RNG lines up; counts agree and the fast-tier
+    # metric tolerance (ISSUE 12) bounds the derived float columns.
+    eng = _make_engine(planner_mode=True)
+    scores = eng.run_grid(ET_CONFIGS)
+    for keys in ET_CONFIGS:
+        ref = ref_engine.run_config(keys)
+        ours, theirs = scores[keys], ref
+        assert ours[3][:3] == theirs[3][:3]  # fp/fn/tp counts
+        _metrics_close(ours[3][3:], theirs[3][3:])
+        for proj in ref_engine.project_names:
+            assert ours[2][proj][:3] == theirs[2][proj][:3]
+            _metrics_close(ours[2][proj][3:], theirs[2][proj][3:])
+
+
+def test_planner_mesh_padded_plan_matches_singles(ref_engine):
+    # 8 virtual CPU devices (conftest): 3 DT configs pad to a batch of 8;
+    # the 5 pad slots repeat configs[0] and are masked out on the host, so
+    # results must still be bit-identical to the per-config path.
+    eng = _make_engine(planner_mode=True, mesh=sweep.default_mesh())
+    configs = DT_CONFIGS[:3]
+    plans = planner.plan_grid(configs, devices=eng.mesh.devices.size,
+                              n=N_TESTS, n_folds=eng.n_folds,
+                              tree_overrides=eng.tree_overrides)
+    assert len(plans) == 1 and plans[0].pad == 5
+    scores = eng.run_grid(configs)
+    for keys in configs:
+        ref = ref_engine.run_config(keys)
+        assert scores[keys][2] == ref[2]
+        assert scores[keys][3] == ref[3]
+
+
+def test_plan_salvage_quarantines_only_the_bad_member(ref_engine,
+                                                      monkeypatch):
+    # A plan abandoned by the dispatch guard is salvaged per-config; a
+    # member that then fails deterministically is quarantined ALONE — its
+    # plan-mates' scores still match the reference (a poisoned batch
+    # would be a masking bug).
+    eng = _make_engine(planner_mode=True)
+    victim = DT_CONFIGS[1]
+
+    def broken_plan(plan):
+        raise RuntimeError("Mosaic lowering failed (injected): bad member")
+
+    orig_run_config = eng.run_config
+
+    def flaky_config(keys, timings=None):
+        if tuple(keys) == victim:
+            raise RuntimeError("shape mismatch (injected): victim only")
+        return orig_run_config(keys, timings)
+
+    monkeypatch.setattr(eng, "run_plan", broken_plan)
+    monkeypatch.setattr(eng, "run_config", flaky_config)
+
+    scores = eng.run_grid(DT_CONFIGS)
+    assert victim not in scores
+    assert eng.quarantined[victim]["fault_class"] == "deterministic"
+    assert set(scores) == set(DT_CONFIGS) - {victim}
+    for keys in scores:
+        ref = ref_engine.run_config(keys)
+        assert scores[keys][2] == ref[2]
+        assert scores[keys][3] == ref[3]

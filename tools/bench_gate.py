@@ -57,8 +57,13 @@ PER_CONFIG_CEIL = 2.0
 # blowup vs the last comparable round fails the gate from round 7 on.
 HIGHER_BETTER = ("value", "scores_speedup", "shap_speedup", "serve_rps",
                  "fit_gflops")
+# grid_dispatch_count (round 8+, the ISSUE-12 engine-tax census): fresh
+# XLA dispatches for a whole-216-grid planner scores run — an integer
+# structural property (#plans), so any growth is a real engine
+# regression, but it rides the same ratio ceiling as the walls. Absent
+# from rounds <= r07, hence vacuous against them.
 LOWER_BETTER = ("t_ours_scores_s", "t_ours_shap_s", "t_ours_fit_s",
-                "serve_p99_ms")
+                "serve_p99_ms", "grid_dispatch_count")
 
 
 def load_history(repo=REPO):

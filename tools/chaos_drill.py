@@ -1,9 +1,10 @@
-"""Process-level chaos harness: the two ISSUE-11 acceptance drills with
-no human in the loop.
+"""Process-level chaos harness: the ISSUE-11 acceptance drills (plus the
+ISSUE-12 planner drill) with no human in the loop.
 
     python tools/chaos_drill.py sweep    # the kill drill
+    python tools/chaos_drill.py plan     # SIGKILL inside a family program
     python tools/chaos_drill.py serve    # the drain drill
-    python tools/chaos_drill.py          # both; exit 0 iff every drill PASSes
+    python tools/chaos_drill.py          # all; exit 0 iff every drill PASSes
     python tools/chaos_drill.py --json   # machine-readable verdicts
     python tools/chaos_drill.py --keep   # keep scratch dirs (debugging)
 
@@ -18,6 +19,18 @@ child's log (completed configs + partial folds > 0 — proof the rerun
 skipped finished work), and the two scores pickles bit-identical in
 scores content (``pickle.dumps(v[2:])`` per config; v[:2] are wall
 clocks, which legitimately differ).
+
+The plan drill (plan, ISSUE 12): the same kill discipline with the sweep
+in PLANNER mode (``write_scores(planner=True)``) and all three configs
+members of ONE family plan — so the SIGKILL lands between two fold
+fsyncs *inside a single fused family program*. PASS proves the plan
+executor's journal ordering (run_plan: per member, folds then config
+record) keeps the per-config resume quantum: the restart replays the
+completed member, re-fits ONLY the killed member's missing folds, and
+re-plans the untouched member, with final scores bit-identical to an
+uninterrupted planner run. Decision Tree configs (the exact,
+single-tree grower) make bit-identity a hard requirement, not a
+fast-tier tolerance.
 
 The drain drill (serve): spawns ``python -m flake16_framework_tpu serve
 --hold --registry DIR`` as a child, waits for its SERVE_READY line (AOT
@@ -71,12 +84,22 @@ SWEEP_CONFIGS = [
 KILL_CONFIG = 1   # die mid-sweep: config 0 already journalled complete
 KILL_FOLD = 5     # ...and mid-config: folds 1-5 journalled, 6-10 not
 
+# Plan drill (ISSUE 12): one family, so the planner fuses all three into
+# a SINGLE device program — the kill must land between fold fsyncs inside
+# it. Decision Tree = the exact grower: cross-path bit-identity (plan
+# program vs the per-config fold-subset resume) is exact, not fast-tier.
+PLAN_CONFIGS = [
+    ("NOD", "Flake16", "None", "None", "Decision Tree"),
+    ("OD", "Flake16", "None", "None", "Decision Tree"),
+    ("OD", "Flake16", "Scaling", "SMOTE", "Decision Tree"),
+]
+
 RUNNER_TEMPLATE = """\
 import sys
 sys.path.insert(0, {repo!r})
 from flake16_framework_tpu.pipeline import write_scores
 write_scores(tests_file={tests!r}, out_file=sys.argv[1],
-             configs={configs!r}, max_depth=8,
+             configs={configs!r}, max_depth=8, planner={planner!r},
              tree_overrides={{"Extra Trees": 4, "Random Forest": 4}})
 """
 
@@ -85,9 +108,10 @@ def log(msg):
     print(f"chaos_drill: {msg}", flush=True)
 
 
-def drill_sweep(workdir):
-    """SIGKILL mid-config -> supervised restart -> journal replay ->
-    scores bit-identical. Returns a verdict dict."""
+def _kill_drill(workdir, name, configs, planner):
+    """Shared body of the sweep/plan kill drills: SIGKILL mid-config ->
+    supervised restart -> journal replay -> scores bit-identical vs an
+    uninterrupted run of the SAME engine path. Returns a verdict dict."""
     from flake16_framework_tpu import config as cfg
     from flake16_framework_tpu.resilience import inject
     from flake16_framework_tpu.resilience.supervisor import supervise
@@ -99,7 +123,7 @@ def drill_sweep(workdir):
     runner = os.path.join(workdir, "runner.py")
     with open(runner, "w") as fd:
         fd.write(RUNNER_TEMPLATE.format(
-            repo=REPO, tests=tests, configs=SWEEP_CONFIGS))
+            repo=REPO, tests=tests, configs=configs, planner=planner))
 
     checks = {}
 
@@ -112,15 +136,15 @@ def drill_sweep(workdir):
         checks["ref_rc0"] = r.returncode == 0
         return out
 
-    log("sweep: reference (uninterrupted) run")
+    log(f"{name}: reference (uninterrupted) run")
     ref_out = run_ref()
 
-    kill_idx = list(cfg.iter_config_keys()).index(SWEEP_CONFIGS[KILL_CONFIG])
+    kill_idx = list(cfg.iter_config_keys()).index(configs[KILL_CONFIG])
     chaos_out = os.path.join(workdir, "scores-chaos.pkl")
     chaos_log = os.path.join(workdir, "chaos.log")
     env = dict(os.environ)
     env[inject.ENV_VAR] = f"{kill_idx}:{KILL_FOLD}:sigkill"
-    log(f"sweep: chaos run, SIGKILL at config {kill_idx} fold {KILL_FOLD}")
+    log(f"{name}: chaos run, SIGKILL at config {kill_idx} fold {KILL_FOLD}")
     with open(chaos_log, "w") as lf:
         rc, history = supervise(
             [sys.executable, runner, chaos_out], env=env, cwd=workdir,
@@ -140,7 +164,7 @@ def drill_sweep(workdir):
     if checks["ref_rc0"] and checks["chaos_rc0"]:
         ref = pickle.load(open(ref_out, "rb"))
         chaos = pickle.load(open(chaos_out, "rb"))
-        checks["same_configs"] = set(ref) == set(chaos) == set(SWEEP_CONFIGS)
+        checks["same_configs"] = set(ref) == set(chaos) == set(configs)
         checks["scores_bit_identical"] = all(
             pickle.dumps(ref[k][2:]) == pickle.dumps(chaos[k][2:])
             for k in ref)
@@ -148,8 +172,22 @@ def drill_sweep(workdir):
         checks["journal_finalized"] = not os.path.exists(
             chaos_out + ".journal")
 
-    return {"drill": "sweep", "pass": all(checks.values()),
+    return {"drill": name, "pass": all(checks.values()),
             "checks": checks, "wall_s": round(time.perf_counter() - t0, 2)}
+
+
+def drill_sweep(workdir):
+    """SIGKILL mid-config on the per-config path (ISSUE 11)."""
+    return _kill_drill(workdir, "sweep", SWEEP_CONFIGS, planner=False)
+
+
+def drill_plan(workdir):
+    """SIGKILL inside a family plan program (ISSUE 12): PLAN_CONFIGS all
+    share one family, so the planner runs them as ONE fused program and
+    the kill fires between two of its members' fold fsyncs. The checks
+    are the sweep drill's — what changes is what they prove: fold-
+    granular resume survives family-batched execution."""
+    return _kill_drill(workdir, "plan", PLAN_CONFIGS, planner=True)
 
 
 def drill_serve(workdir):
@@ -230,8 +268,10 @@ def main(argv=None):
     args = sys.argv[1:] if argv is None else list(argv)
     as_json = "--json" in args
     keep = "--keep" in args
-    names = [a for a in args if not a.startswith("--")] or ["sweep", "serve"]
-    drills = {"sweep": drill_sweep, "serve": drill_serve}
+    names = [a for a in args if not a.startswith("--")] or \
+        ["sweep", "plan", "serve"]
+    drills = {"sweep": drill_sweep, "plan": drill_plan,
+              "serve": drill_serve}
     unknown = [n for n in names if n not in drills]
     if unknown:
         raise SystemExit(f"chaos_drill: unknown drill(s) {unknown}; "

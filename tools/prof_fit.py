@@ -2,11 +2,17 @@
 
     python tools/prof_fit.py [--n 400] [--trees 25] [--reps 2]
                              [--growers hist,exact] [--impls auto]
-                             [--models DT,RF,ET] [--engine-only] [--json]
+                             [--models DT,RF,ET] [--devices 1]
+                             [--engine-only] [--plan-only] [--json]
 
-Three measurement layers, cheapest-first (all steady-state: every timed
-call runs once untimed to absorb compiles):
+Four measurement layers, cheapest-first (all timed layers steady-state:
+every timed call runs once untimed to absorb compiles):
 
+0. **Plan table** — the planner's grouping of the full 216-config grid
+   at this shape (parallel/planner.py, ISSUE 12): per plan the family,
+   member count, padded batch and pad-waste %, so padding overhead is
+   visible BEFORE a run. Pure host arithmetic — no jax import, no
+   backend needed (``--plan-only`` works on a machine with neither).
 1. **Engine walls** — ``SweepEngine.run_config`` per bench config
    (bench.py CONFIGS at the bench shape), the exact number the bench's
    ``t_ours_fit_s`` aggregates. Run per grower tier so hist-vs-exact is
@@ -49,6 +55,20 @@ def _steady(fn, reps):
         fn()
         walls.append(time.time() - t0)
     return min(walls)
+
+
+def plan_report(n_tests, n_trees, devices, n_folds=10):
+    """Layer 0: the whole-grid plan table at this shape (host-only —
+    parallel/planner.py imports no jax). ``n_folds`` defaults to the
+    sweep's N_FOLDS; it only feeds the shape signature column."""
+    from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.parallel import planner
+
+    overrides = {"Random Forest": n_trees, "Extra Trees": n_trees}
+    plans = planner.plan_grid(
+        cfg.iter_config_keys(), devices=devices, n=n_tests,
+        n_folds=n_folds, tree_overrides=overrides)
+    return planner.plan_table(plans), planner.format_plan_table(plans)
 
 
 def engine_walls(n_tests, n_trees, growers, models, reps):
@@ -151,15 +171,33 @@ def main(argv=None):
                     help="comma list of hist_impl values for the kernel "
                          "layer (auto,xla,einsum,pallas)")
     ap.add_argument("--models", default="DT,RF,ET")
+    ap.add_argument("--devices", type=int, default=1,
+                    help="mesh width the plan table pads batches to")
     ap.add_argument("--engine-only", action="store_true")
     ap.add_argument("--kernel-only", action="store_true")
+    ap.add_argument("--plan-only", action="store_true",
+                    help="print only the (host-side) plan table")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
+
+    plan_rows, plan_lines = plan_report(args.n, args.trees, args.devices)
+    if args.plan_only:
+        if args.json:
+            print(json.dumps({"n_tests": args.n, "n_trees": args.trees,
+                              "devices": args.devices,
+                              "plan_table": plan_rows}, indent=1))
+        else:
+            print(f"[plans n={args.n} trees={args.trees} "
+                  f"devices={args.devices}]")
+            for line in plan_lines:
+                print(f"  {line}")
+        return 0
 
     import jax
     models = [MODEL_ABBREV.get(m.strip(), m.strip())
               for m in args.models.split(",") if m.strip()]
     result = {"n_tests": args.n, "n_trees": args.trees,
+              "devices": args.devices, "plan_table": plan_rows,
               "backend": jax.default_backend()}
     if not args.kernel_only:
         result["engine"] = engine_walls(
@@ -174,6 +212,9 @@ def main(argv=None):
         print(json.dumps(result, indent=1))
         return 0
     print(f"backend={result['backend']} n={args.n} trees={args.trees}")
+    print(f"\n[plans devices={args.devices}]")
+    for line in plan_lines:
+        print(f"  {line}")
     for grower, rows in result.get("engine", {}).items():
         print(f"\n[engine grower={grower}]")
         for cfgname, r in rows.items():
