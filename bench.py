@@ -498,6 +498,29 @@ def worker(n_tests, n_trees):
         print(json.dumps({"stage": "dispatch", **dispatch_rec}),
               flush=True)
 
+        # f16audit reconciliation (ISSUE 13): the static dispatch census
+        # — len(planner.plan_grid) over the full grid, computed on the
+        # host without tracing — must equal the dispatches the census
+        # above just measured. A mismatch means the executor dispatched
+        # more (or fewer) programs than the planner planned: the
+        # one-program-per-family contract drifted, and main() exits 3
+        # (the audit gate) after banking the record.
+        from flake16_framework_tpu.analysis import rules_ir as _rir
+
+        static_n = len(_rir.static_plans(
+            n=len(g_data[0]), n_folds=g_engine.n_folds,
+            tree_overrides=g_engine.tree_overrides))
+        dispatch_rec.update(
+            audit_static_census=static_n,
+            audit_census_match=(
+                static_n == dispatch_rec["grid_dispatch_count"]),
+        )
+        print(json.dumps({
+            "stage": "audit", "audit_static_census": static_n,
+            "audit_census_match": dispatch_rec["audit_census_match"],
+            "grid_dispatch_count": dispatch_rec["grid_dispatch_count"],
+        }), flush=True)
+
     # SHAP stage. Default impl "auto" = the Pallas kernel on TPU, XLA
     # elsewhere; BENCH_SHAP_IMPL overrides so a hardware A/B (hw_probe
     # tune_shap's xla arm) can ship its winner without a code change.
@@ -965,6 +988,12 @@ def main():
         # lower-is-better from BENCH_r08 on (tools/bench_gate.py).
         grid_dispatch_count=result.get("grid_dispatch_count"),
         grid_plans=result.get("grid_plans"),
+        grid_configs=result.get("grid_configs"),
+        # f16audit reconciliation (ISSUE 13): the planner's static
+        # census and whether it matched the measured dispatch count —
+        # False trips the audit gate (exit 3) after this record prints.
+        audit_static_census=result.get("audit_static_census"),
+        audit_census_match=result.get("audit_census_match"),
         # Crash-tolerance costs (ISSUE 11): fsync'd journal appends as a
         # fraction of the fit wall (acceptance bound <= 2%) and the
         # replay wall a preempted run pays before its first dispatch.
@@ -983,6 +1012,16 @@ def main():
         "vs_baseline": round(speedup, 3),
         "detail": detail,
     }))
+    # Audit gate AFTER the final metric prints: the record is banked
+    # (recovery_watch.persist_bench_json reads the line above) even when
+    # the census reconciliation fails — a drifted dispatch contract must
+    # fail the chain loudly, not silently ship a wrong engine-tax number.
+    if detail.get("audit_census_match") is False:
+        print(f"AUDIT GATE: static census {detail['audit_static_census']}"
+              f" != measured grid_dispatch_count "
+              f"{detail['grid_dispatch_count']}", file=sys.stderr,
+              flush=True)
+        sys.exit(3)
 
 
 def serve_bench():

@@ -19,8 +19,16 @@ plus extension verbs the reference lacks:
         # convert a telemetry run into Chrome-trace/Perfetto JSON
         # (obs/trace.py; load in chrome://tracing or ui.perfetto.dev)
     python -m flake16_framework_tpu lint [PATHS] [--json] [--baseline F]
-        # f16lint: JAX/TPU-hygiene static analysis + 216-config grid
-        # pre-flight (analysis/); exit 1 on unsuppressed findings
+        # f16lint: JAX/TPU-hygiene static analysis + config-grid
+        # pre-flight (analysis/); exit 1 on unsuppressed findings;
+        # --ir folds the f16audit IR findings in
+    python -m flake16_framework_tpu audit [--json] [--budget-mb MB]
+        # f16audit: trace the real entry points (planner family
+        # programs, serve AOT executables, SHAP kernels) with abstract
+        # inputs and statically prove the dispatch/determinism/memory/
+        # sharding contracts (analysis/ir.py, I-rules); reconciles the
+        # static dispatch census against the benched
+        # grid_dispatch_count and prints per-plan memory envelopes
     python -m flake16_framework_tpu bench --gate [RESULT.json]
         # regression gate over the committed BENCH_r*.json trajectory
         # (tools/bench_gate.py); exit 1 naming the regressed metric
@@ -192,6 +200,12 @@ def main(argv=None):
         from flake16_framework_tpu.analysis.cli import lint_main
 
         code = lint_main(args)
+        if code:
+            raise SystemExit(code)
+    elif command == "audit":
+        from flake16_framework_tpu.analysis.cli import audit_main
+
+        code = audit_main(args)
         if code:
             raise SystemExit(code)
     else:

@@ -1,15 +1,21 @@
-"""f16lint — AST-based JAX/TPU-hygiene static analysis + grid pre-flight.
+"""f16lint + f16audit — static analysis from source text down to traced IR.
 
 The launch-time twin of the telemetry subsystem (obs/): catch host
-syncs, retrace hazards, dtype drift, a malformed 216-config grid, and
+syncs, retrace hazards, dtype drift, a malformed config grid, and
 telemetry schema drift on the HOST, in seconds, before a device is ever
 touched (ISSUE 2; PROFILE.md "Static analysis" has the rule catalog).
 
     python -m flake16_framework_tpu lint [PATHS] [--json] [--baseline F]
+    python -m flake16_framework_tpu audit [--json] [--budget-mb MB]
 
 Engine mechanics in engine.py; rule packs in rules_jax.py (J-rules),
-rules_grid.py (G-rules), rules_obs.py (O-rules); CLI in cli.py. Nothing
-here imports jax.
+rules_grid.py (G-rules), rules_obs.py (O-rules), rules_ir.py (I-rules —
+the f16audit jaxpr-level pack, ISSUE 13); CLI in cli.py. Import
+contract: nothing imports jax at module level — plain ``lint`` stays a
+host-only pre-flight. The ONE exception is ir.py (the jaxpr
+tracer/walkers), which imports jax by design and is therefore only
+imported lazily, from inside the ``audit``/``lint --ir`` entry points
+(tests/test_lint.py::test_analysis_never_imports_jax enforces this).
 """
 
 from flake16_framework_tpu.analysis.engine import (  # noqa: F401

@@ -1,7 +1,9 @@
-"""The ``lint`` CLI verb (``__main__.py``), mirroring ``report``:
+"""The ``lint`` and ``audit`` CLI verbs (``__main__.py``):
 
     python -m flake16_framework_tpu lint [PATHS...] [--json]
-        [--baseline FILE] [--telemetry PATH] [--rules]
+        [--baseline FILE] [--telemetry PATH] [--rules] [--ir]
+    python -m flake16_framework_tpu audit [--json] [--budget-mb MB]
+        [--n N] [--trees T] [--max-depth D] [--no-mesh]
 
 With no PATHS the package itself is linted (the CI gate invocation —
 tests/test_lint.py shells exactly this and asserts exit 0). ``--json``
@@ -11,7 +13,14 @@ schema family as telemetry, validated by the same drift lint).
 (tools/gen_lint_baseline.py writes one). ``--telemetry`` additionally
 validates emitted telemetry documents at PATH (repeatable — the folded-in
 tools/check_telemetry_schema.py behavior). ``--rules`` prints the rule
-catalog and exits 0.
+catalog and exits 0. ``--ir`` folds the f16audit IR findings into the
+lint run (imports jax — the one lint path that does).
+
+``audit`` is the standalone f16audit gate: trace every real entry point
+(planner family programs, serve AOT executables, both SHAP kernels) and
+run the I-rule pack — dispatch census reconciliation, host-callback and
+determinism proofs, per-plan memory envelopes, shard_map sharding audit.
+Exit 0 = every contract holds; findings print in lint format.
 
 Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = usage error
 (mirroring the ValueError convention of the other verbs).
@@ -22,9 +31,13 @@ import os
 import sys
 
 from flake16_framework_tpu.analysis import engine as eng
-from flake16_framework_tpu.analysis import rules_grid, rules_jax, rules_obs
+from flake16_framework_tpu.analysis import (
+    rules_grid, rules_ir, rules_jax, rules_obs,
+)
 
-PACKS = (rules_jax, rules_grid, rules_obs)
+# rules_ir registers its catalog only (no check_* hooks): plain lint
+# stays jax-free; I-findings come from run_audit via ``audit``/``--ir``.
+PACKS = (rules_jax, rules_grid, rules_obs, rules_ir)
 
 
 def default_paths():
@@ -36,13 +49,19 @@ def build_engine():
     return eng.Engine(PACKS)
 
 
-def run_lint(paths=None, baseline_file=None, telemetry_paths=()):
-    """(LintResult, telemetry-doc findings folded in) for PATHS."""
+def run_lint(paths=None, baseline_file=None, telemetry_paths=(),
+             ir=False):
+    """(LintResult, telemetry-doc findings folded in) for PATHS. With
+    ``ir`` the f16audit IR findings join the result (imports jax)."""
     engine = build_engine()
     result = engine.lint(paths or default_paths(),
-                         baseline=eng.load_baseline(baseline_file))
+                         baseline=eng.load_baseline(baseline_file,
+                                                    rules=engine.rules))
     if telemetry_paths:
         result.findings.extend(rules_obs.check_docs(telemetry_paths))
+    if ir:
+        ir_findings, _info = rules_ir.run_audit()
+        result.findings.extend(ir_findings)
     return result
 
 
@@ -50,6 +69,7 @@ def lint_main(args, out=None):
     out = out or sys.stdout
     as_json = False
     show_rules = False
+    with_ir = False
     baseline = None
     telemetry = []
     paths = []
@@ -59,6 +79,8 @@ def lint_main(args, out=None):
             as_json = True
         elif a == "--rules":
             show_rules = True
+        elif a == "--ir":
+            with_ir = True
         elif a == "--baseline":
             baseline = next(it, None)
             if baseline is None:
@@ -80,7 +102,7 @@ def lint_main(args, out=None):
         return 0
 
     result = run_lint(paths, baseline_file=baseline,
-                      telemetry_paths=telemetry)
+                      telemetry_paths=telemetry, ir=with_ir)
     report = result.to_report()
     if as_json:
         out.write(json.dumps(report, indent=1) + "\n")
@@ -93,3 +115,68 @@ def lint_main(args, out=None):
             f"{c['files']} file(s); suppressed: {c['suppressed_inline']} "
             f"inline, {c['suppressed_baseline']} baseline\n")
     return 1 if result.findings else 0
+
+
+def audit_report(findings, info):
+    """The ``audit-report-v1`` document (obs.schema.AUDIT_SCHEMA)."""
+    from flake16_framework_tpu.obs import schema
+
+    errors = [f for f in findings if f.severity == eng.ERROR]
+    return {
+        "schema": schema.AUDIT_SCHEMA,
+        "findings": [f.as_dict() for f in findings],
+        "counts": {"errors": len(errors),
+                   "warnings": len(findings) - len(errors),
+                   "entries": len(info["entries"])},
+        "census": info["census"],
+        "envelopes": info["envelopes"],
+        "entries": info["entries"],
+        "budget_mb": info["budget_mb"],
+    }
+
+
+def audit_main(args, out=None):
+    out = out or sys.stdout
+    as_json = False
+    kw = {}
+    it = iter(args)
+
+    def arg(flag):
+        v = next(it, None)
+        if v is None:
+            raise ValueError(f"{flag} needs an argument")
+        return v
+
+    for a in it:
+        if a == "--json":
+            as_json = True
+        elif a == "--budget-mb":
+            kw["budget_mb"] = float(arg(a))
+        elif a == "--n":
+            kw["n"] = int(arg(a))
+        elif a == "--trees":
+            kw["n_trees"] = int(arg(a))
+        elif a == "--max-depth":
+            kw["max_depth"] = int(arg(a))
+        elif a == "--no-mesh":
+            kw["mesh"] = False
+        else:
+            raise ValueError(f"Unrecognized audit option {a!r}")
+
+    findings, info = rules_ir.run_audit(**kw)
+    if as_json:
+        out.write(json.dumps(audit_report(findings, info), indent=1)
+                  + "\n")
+    else:
+        for f in findings:
+            out.write(f.render() + "\n")
+        c = info["census"]
+        out.write(
+            f"audit: {len(info['entries'])} entr(ies) traced; census "
+            f"static={c['static']} runtime={c['runtime']} "
+            f"({c['source']}); {len(findings)} finding(s)\n")
+        for env in info["envelopes"]:
+            out.write(
+                f"  {env['entry']:<44} batch={env['batch']:<4} "
+                f"peak={env['peak_mb']:.2f} MB\n")
+    return 1 if findings else 0

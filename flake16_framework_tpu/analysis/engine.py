@@ -35,7 +35,26 @@ import re
 from dataclasses import dataclass
 
 ERROR, WARNING = "error", "warning"
-BASELINE_SCHEMA = "flake16-lint-baseline-v1"
+# v2 groups fingerprints per rule pack so a pack added AFTER baseline
+# generation cannot silently absorb findings it never saw (the
+# gen_lint_baseline bug: a v1 flat list regenerated pre-I-rules would
+# swallow later I-findings wholesale). v1 documents still load.
+BASELINE_SCHEMA = "flake16-lint-baseline-v2"
+BASELINE_SCHEMA_V1 = "flake16-lint-baseline-v1"
+
+# Rule-id prefix letter -> pack section name in a v2 baseline. The
+# fingerprint format (``RULE:hash``) keeps the rule id recoverable, so
+# grouping needs no extra bookkeeping at save time.
+PACK_PREFIXES = {"E": "engine", "J": "jax", "G": "grid", "O": "obs",
+                 "I": "ir"}
+
+
+def pack_of(rule_id):
+    pack = PACK_PREFIXES.get(rule_id[:1])
+    if pack is None:
+        raise ValueError(f"rule id {rule_id!r} matches no known pack "
+                         f"(prefixes: {sorted(PACK_PREFIXES)})")
+    return pack
 
 # One engine-owned rule: a file the AST rules never saw is a finding, not
 # a silent skip (a syntax error in a sweep module would otherwise pass).
@@ -266,23 +285,57 @@ class Engine:
             n_files=len(modules), rules=self.rules)
 
 
-def load_baseline(path):
+def load_baseline(path, rules=None):
     """Fingerprint list from a baseline file (empty when absent: a fresh
-    checkout with no baseline is not a lint failure)."""
+    checkout with no baseline is not a lint failure). Reads both v2
+    (per-pack sections) and legacy v1 (flat list). When ``rules`` — the
+    engine's rule catalog — is given, any fingerprint whose rule id is
+    unknown raises instead of silently suppressing nothing (a typo or a
+    renamed rule in a baseline is stale suppression debt, not noise)."""
     if path is None or not os.path.exists(path):
         return []
     with open(path) as fd:
         obj = json.load(fd)
-    if not isinstance(obj, dict) or obj.get("schema") != BASELINE_SCHEMA:
+    schema = obj.get("schema") if isinstance(obj, dict) else None
+    if schema == BASELINE_SCHEMA:
+        packs = obj.get("packs", {})
+        fps = [fp for pack in sorted(packs) for fp in packs[pack]]
+    elif schema == BASELINE_SCHEMA_V1:
+        fps = list(obj.get("fingerprints", []))
+    else:
         raise ValueError(
-            f"{path}: not a {BASELINE_SCHEMA} baseline document")
-    return list(obj.get("fingerprints", []))
+            f"{path}: not a {BASELINE_SCHEMA} (or {BASELINE_SCHEMA_V1}) "
+            "baseline document")
+    if rules is not None:
+        unknown = sorted({fp.split(":", 1)[0] for fp in fps}
+                         - set(rules))
+        if unknown:
+            raise ValueError(
+                f"{path}: baseline names rule id(s) unknown to the "
+                f"catalog: {unknown} — regenerate with "
+                "tools/gen_lint_baseline.py")
+    return fps
 
 
-def save_baseline(path, findings):
+def group_fingerprints(findings):
+    """{pack: sorted fingerprint list} for a finding set — the v2
+    baseline body."""
+    packs = {}
+    for f in findings:
+        packs.setdefault(pack_of(f.rule), []).append(f.fingerprint)
+    return {pack: sorted(fps) for pack, fps in sorted(packs.items())}
+
+
+def save_baseline(path, findings, *, keep_packs=None):
+    """Write a v2 baseline. ``keep_packs`` ({pack: [fingerprints]})
+    carries sections to preserve verbatim — the per-pack regeneration
+    path: packs regenerated from ``findings`` override, others survive
+    untouched."""
+    packs = dict(keep_packs or {})
+    packs.update(group_fingerprints(findings))
     obj = {
         "schema": BASELINE_SCHEMA,
-        "fingerprints": sorted(f.fingerprint for f in findings),
+        "packs": {pack: packs[pack] for pack in sorted(packs)},
     }
     from flake16_framework_tpu.utils.atomic import atomic_write
 

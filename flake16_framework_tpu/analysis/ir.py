@@ -1,0 +1,415 @@
+"""f16audit IR layer — trace the engine's REAL entry points to closed
+jaxprs and walk them (ISSUE 13).
+
+f16lint (the rest of ``analysis/``) sees source text; this module sees
+the *traced program*: the planner family programs (``make_plan_fn``),
+the serve AOT executables (obs/aot.py handles), and both SHAP kernels,
+each traced with abstract ``ShapeDtypeStruct`` inputs — no data, no
+device dispatch, seconds on the CPU backend. The walkers statically
+verify the contracts PR 11–12 made load-bearing:
+
+- callback census (I1): ``pure_callback``/``io_callback``/
+  ``debug_callback`` primitives anywhere in jit-reachable code would be
+  a host round-trip per dispatch — ground truth for J101's AST taint
+  heuristic;
+- determinism (I2): no nondeterministic primitives and no f64 avals, so
+  write-ahead-journal resume stays bit-identical by construction;
+- peak-memory envelope (I4): a buffer-liveness walk over the jaxpr
+  (documented upper bound, see ``peak_live_bytes``) plus the lowered
+  cost model, known BEFORE first silicon instead of via OOM;
+- sharding audit (I5): the ``shard_map`` mesh path keeps the "config"
+  axis sharded — no accidental all-gather/full replication.
+
+IMPORT CONTRACT: this module imports jax at module level and therefore
+must ONLY be imported lazily, from audit entry points (analysis/cli.py
+``audit``/``--ir``, rules_ir's finding builders, sweep's budget
+pre-flight). The rest of ``analysis/`` must keep working without jax
+(tests/test_lint.py test_analysis_never_imports_jax).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Host-callback primitives: any of these inside a jit program is a
+# device->host round trip per dispatch (I1 ground truth).
+CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "outside_call",
+    "host_callback_call",
+})
+# Primitives whose results are not a pure function of their inputs —
+# rng_uniform is explicitly documented as implementation-defined
+# (jax.lax.rng_uniform), unlike the threefry/RBG key-based primitives.
+NONDET_PRIMS = frozenset({"rng_uniform"})
+# Cross-device collectives (I5): none of these may name the config axis
+# inside a shard_map body — the planner's members are independent, so a
+# collective over "config" is an accidental gather/replication.
+COLLECTIVE_PRIMS = frozenset({
+    "all_gather", "all_to_all", "psum", "pmax", "pmin", "ppermute",
+    "pbroadcast", "reduce_scatter", "psum_scatter",
+})
+# Avals wider than f32 break the bit-identical resume contract when x64
+# sneaks on (I2's promotion check).
+_WIDE_DTYPES = ("float64", "complex128", "int64", "uint64")
+
+
+# -- jaxpr traversal ----------------------------------------------------
+
+
+def _jaxprs_in(val):
+    """Sub-jaxprs inside one eqn-param value (ClosedJaxpr, Jaxpr, or
+    nested lists/tuples of them — pjit/scan/while/cond/switch/shard_map
+    all stash their bodies under different param shapes)."""
+    if isinstance(val, jax.core.ClosedJaxpr):
+        yield val.jaxpr
+    elif isinstance(val, jax.core.Jaxpr):
+        yield val
+    elif isinstance(val, (list, tuple)):
+        for v in val:
+            yield from _jaxprs_in(v)
+
+
+def sub_jaxprs(eqn):
+    """Every sub-jaxpr one equation closes over."""
+    for val in eqn.params.values():
+        yield from _jaxprs_in(val)
+
+
+def iter_eqns(jaxpr):
+    """Depth-first walk over ALL equations, recursing through sub-jaxprs
+    (the pjit wrapper, scan/while bodies, cond/switch branches,
+    shard_map bodies, ...)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in sub_jaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+def _open(closed_or_jaxpr):
+    if isinstance(closed_or_jaxpr, jax.core.ClosedJaxpr):
+        return closed_or_jaxpr.jaxpr
+    return closed_or_jaxpr
+
+
+def primitive_census(closed):
+    """{primitive name: count} over the whole program, sub-jaxprs
+    included — the raw material every I-rule filters."""
+    census = {}
+    for eqn in iter_eqns(_open(closed)):
+        name = eqn.primitive.name
+        census[name] = census.get(name, 0) + 1
+    return census
+
+
+# -- walkers (one per contract) -----------------------------------------
+
+
+def callback_sites(closed):
+    """Sorted host-callback primitive names present in the program (I1).
+    Empty list == statically proven free of host round-trips."""
+    census = primitive_census(closed)
+    return sorted(set(census) & CALLBACK_PRIMS)
+
+
+def nondet_sites(closed):
+    """Sorted nondeterministic primitive names present (I2)."""
+    census = primitive_census(closed)
+    return sorted(set(census) & NONDET_PRIMS)
+
+
+def wide_dtype_sites(closed):
+    """[(primitive, dtype)] for equations producing 64-bit avals (I2's
+    promotion check): under the sweep's x64-off contract these silently
+    downcast; with x64 on they break bit-identical journal resume."""
+    out = []
+    seen = set()
+    jaxpr = _open(closed)
+    for v in jaxpr.invars:
+        dt = str(getattr(v.aval, "dtype", ""))
+        if dt in _WIDE_DTYPES and ("<input>", dt) not in seen:
+            seen.add(("<input>", dt))
+            out.append(("<input>", dt))
+    for eqn in iter_eqns(jaxpr):
+        for v in eqn.outvars:
+            dt = str(getattr(getattr(v, "aval", None), "dtype", ""))
+            if dt in _WIDE_DTYPES:
+                key = (eqn.primitive.name, dt)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(key)
+    return out
+
+
+def _aval_bytes(aval):
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    size = 1
+    for d in shape:
+        size *= int(d)
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        # Extended dtypes (PRNG key avals like key<fry>) have no numpy
+        # equivalent; their itemsize attr (2x uint32 for threefry) or a
+        # conservative 8 bytes keeps the envelope an upper bound.
+        itemsize = int(getattr(dtype, "itemsize", 0) or 8)
+    return size * itemsize
+
+
+def peak_live_bytes(closed_or_jaxpr):
+    """Upper-bound peak resident bytes of one program by buffer-liveness
+    walk (I4's memory envelope).
+
+    Methodology (PROFILE.md "IR audit"): walk equations in program
+    order; a var becomes live when produced (inputs/consts at entry) and
+    dies after its last textual use; the peak is the max live-set byte
+    total. Sub-jaxprs (scan/while/cond bodies) contribute their own
+    recursive peak on top of the parent's live set minus the equation's
+    own operands (they are the sub-program's inputs, not extra copies).
+    This is an ENVELOPE, not a prediction: XLA fuses, rematerializes and
+    double-buffers, so the true peak is usually lower — but a plan whose
+    envelope exceeds the device budget is refused before dispatch
+    (sweep.PlanOverBudget) rather than discovered by OOM on silicon.
+    """
+    jaxpr = _open(closed_or_jaxpr)
+    last_use = {}
+    for idx, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if isinstance(v, jax.core.Var):
+                last_use[v] = idx
+    for v in jaxpr.outvars:
+        if isinstance(v, jax.core.Var):
+            last_use[v] = len(jaxpr.eqns)  # program outputs live to the end
+    live = {}
+    cur = 0
+    for v in tuple(jaxpr.constvars) + tuple(jaxpr.invars):
+        if v not in live:
+            live[v] = _aval_bytes(v.aval)
+            cur += live[v]
+    peak = cur
+    for idx, eqn in enumerate(jaxpr.eqns):
+        for sub in sub_jaxprs(eqn):
+            io = sum(_aval_bytes(v.aval) for v in eqn.invars
+                     if isinstance(v, jax.core.Var))
+            io += sum(_aval_bytes(v.aval) for v in eqn.outvars)
+            inner = cur - io + peak_live_bytes(sub)
+            peak = max(peak, inner, cur)
+        for v in eqn.outvars:
+            if isinstance(v, jax.core.Var) and v not in live:
+                live[v] = _aval_bytes(v.aval)
+                cur += live[v]
+        peak = max(peak, cur)
+        used = {v for v in tuple(eqn.invars) + tuple(eqn.outvars)
+                if isinstance(v, jax.core.Var)}
+        for v in used:
+            if v in live and last_use.get(v, -1) <= idx:
+                cur -= live.pop(v)
+    return peak
+
+
+def memory_envelope(closed):
+    """The I4 pre-flight numbers for one traced program: argument bytes,
+    output bytes, and the liveness-walk peak (``peak_live_bytes``)."""
+    jaxpr = _open(closed)
+    arg_bytes = sum(_aval_bytes(v.aval) for v in jaxpr.invars)
+    out_bytes = sum(_aval_bytes(v.aval) for v in jaxpr.outvars)
+    return {
+        "arg_bytes": int(arg_bytes),
+        "out_bytes": int(out_bytes),
+        "peak_bytes": int(peak_live_bytes(jaxpr)),
+    }
+
+
+def lowered_cost(fn, args, kwargs=None):
+    """Best-effort ``{flops, bytes_accessed}`` from the XLA cost model of
+    the UNCOMPILED lowering (jax.stages.Lowered.cost_analysis — no
+    device executable is built). {} when the model declines."""
+    try:
+        jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+        cost = jitted.lower(*args, **(kwargs or {})).cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if not isinstance(cost, dict):
+            return {}
+        return {
+            "flops": float(cost.get("flops", 0.0) or 0.0),
+            "bytes_accessed": float(
+                cost.get("bytes accessed", 0.0) or 0.0),
+        }
+    except Exception:
+        return {}
+
+
+def _axis_names(val):
+    """Flatten a collective's axis-name param to a set of names."""
+    if val is None:
+        return set()
+    if isinstance(val, (list, tuple, set, frozenset)):
+        out = set()
+        for v in val:
+            out |= _axis_names(v)
+        return out
+    return {val}
+
+
+def shard_map_audit(closed, axis="config"):
+    """I5: problems with the mesh path's sharding, as strings (empty ==
+    clean). Per shard_map equation:
+
+    - at least one input must actually be sharded over ``axis`` (a mesh
+      program whose every in_name drops the axis is fully replicated —
+      the batch would run in full on every device);
+    - every output must carry ``axis`` in its out_names (a dropped axis
+      means an implicit replication/gather of per-config results);
+    - no collective primitive inside the body may name ``axis``: plan
+      members are independent, so a psum/all_gather over "config" is an
+      accidental cross-config gather.
+    Returns (n_shard_maps, problems)."""
+    problems = []
+    smaps = [e for e in iter_eqns(_open(closed))
+             if e.primitive.name == "shard_map"]
+    for i, eqn in enumerate(smaps):
+        where = f"shard_map[{i}]"
+        in_names = eqn.params.get("in_names", ())
+        if isinstance(in_names, dict):
+            in_names = (in_names,)
+        sharded = any(
+            axis in names
+            for spec in in_names
+            for names in (spec.values() if hasattr(spec, "values")
+                          else ())
+        )
+        if in_names and not sharded:
+            problems.append(
+                f"{where}: no input is sharded over {axis!r} — the whole "
+                "batch is replicated onto every device")
+        out_names = eqn.params.get("out_names", ())
+        if isinstance(out_names, dict):
+            out_names = (out_names,)
+        for j, spec in enumerate(out_names):
+            names = set()
+            for v in (spec.values() if hasattr(spec, "values") else ()):
+                names |= _axis_names(v)
+            if axis not in names:
+                problems.append(
+                    f"{where}: output {j} drops the {axis!r} axis from "
+                    "out_names — per-config results would be "
+                    "replicated/gathered")
+        for sub in sub_jaxprs(eqn):
+            for inner in iter_eqns(sub):
+                if inner.primitive.name not in COLLECTIVE_PRIMS:
+                    continue
+                named = set()
+                for key in ("axes", "axis_name", "axis_index_groups"):
+                    named |= _axis_names(inner.params.get(key))
+                if axis in named:
+                    problems.append(
+                        f"{where}: collective "
+                        f"{inner.primitive.name!r} over the {axis!r} "
+                        "axis — plan members are independent; this "
+                        "gathers across configs")
+    return len(smaps), problems
+
+
+# -- entry-point tracing ------------------------------------------------
+
+
+def trace_entry(fn, args, kwargs=None):
+    """ClosedJaxpr of ``fn`` at abstract args. ``fn`` may be a plain
+    function, a jitted callable, or an obs/aot.AotExecutableCache — the
+    cache's ``traceable()`` handle is used so tracing never bumps the
+    runtime dispatch census the audit reconciles against (I3)."""
+    t = getattr(fn, "traceable", None)
+    if callable(t):
+        fn = t()[0]
+    if kwargs:
+        fn = functools.partial(fn, **kwargs)
+    return jax.make_jaxpr(fn)(*args)
+
+
+def audit_mesh(axis="config"):
+    """A 1-device mesh over the local (CPU) backend — enough to trace
+    the REAL shard_map program structure for the I5 audit; axis names
+    and in/out_names are recorded identically at any mesh width."""
+    return jax.sharding.Mesh(np.array(jax.devices()[:1]), (axis,))
+
+
+def abstract_plan_args(plan, *, n_projects):
+    """The ShapeDtypeStruct argument tuple of one plan's program, in
+    make_plan_fn's plan_batch order: (x, y_raw, fls, preps, bals, keys,
+    train_masks, test_masks, project_ids). Fold masks are float32 0/1
+    (parallel/folds.fold_masks), NOT bool — the lax.switch resample
+    branches require identical output dtypes."""
+    n, n_feat, _n_trees, n_folds, _cap = plan.shape
+    batch = plan.batch
+    s = jax.ShapeDtypeStruct
+    return (
+        s((n, n_feat), jnp.float32),          # x (selected columns)
+        s((n,), jnp.int32),                   # y_raw
+        s((batch,), jnp.int32),               # flaky labels
+        s((batch,), jnp.int32),               # prep codes
+        s((batch,), jnp.int32),               # bal codes
+        s((batch, 2), jnp.uint32),            # per-config RNG keys
+        s((batch, n_folds, n), jnp.float32),  # train masks
+        s((batch, n_folds, n), jnp.float32),  # test masks
+        s((n,), jnp.int32),                   # project ids
+    )
+
+
+def trace_plan_program(plan, *, mesh=None, n_projects, max_depth=48,
+                       grower=None):
+    """ClosedJaxpr of one plan's whole-family program — the SAME
+    ``make_plan_fn`` program SweepEngine.run_plan dispatches, traced at
+    the plan's padded batch shape with abstract inputs."""
+    from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.parallel import sweep
+
+    _fs_name, model_name = plan.family
+    n, n_feat, n_trees, n_folds, _cap = plan.shape
+    spec = cfg.MODELS[model_name]
+    if spec.n_trees != n_trees:
+        spec = type(spec)(spec.name, n_trees, spec.bootstrap,
+                          spec.random_splits, spec.sqrt_features)
+    fn = sweep.make_plan_fn(
+        spec, mesh, n=n, n_feat=n_feat, n_projects=n_projects,
+        max_depth=max_depth, n_folds=n_folds, grower=grower)
+    return trace_entry(fn, abstract_plan_args(plan, n_projects=n_projects))
+
+
+def abstract_forest(n_trees, max_nodes, n_classes=2):
+    """A ShapeDtypeStruct Forest (ops/trees.py layout) for abstract
+    tracing of predict/SHAP entry points."""
+    from flake16_framework_tpu.ops import trees
+
+    s = jax.ShapeDtypeStruct
+    return trees.Forest(
+        feature=s((n_trees, max_nodes), jnp.int32),
+        threshold=s((n_trees, max_nodes), jnp.float32),
+        left=s((n_trees, max_nodes), jnp.int32),
+        right=s((n_trees, max_nodes), jnp.int32),
+        value=s((n_trees, max_nodes, n_classes), jnp.float32),
+        n_nodes=s((n_trees,), jnp.int32),
+        max_depth=s((), jnp.int32),
+    )
+
+
+def shap_kernel_entries(*, n_trees=100, max_nodes=64, n_samples=32,
+                        n_feat=16, depth=8):
+    """{name: (fn, args, kwargs)} for both SHAP kernels at one abstract
+    shape. The pallas kernel is traced with interpret=True so the audit
+    runs on hosts without a TPU backend — the jaxpr structure is the
+    same; only the backend lowering differs."""
+    from flake16_framework_tpu.ops import treeshap
+
+    forest = abstract_forest(n_trees, max_nodes)
+    x = jax.ShapeDtypeStruct((n_samples, n_feat), jnp.float32)
+    return {
+        "shap.xla": (treeshap._xla_forest_shap, (forest, x),
+                     {"depth": depth}),
+        "shap.pallas": (treeshap._pallas_forest_shap, (forest, x),
+                        {"depth": depth, "interpret": True}),
+    }

@@ -1,15 +1,20 @@
 """Rule pack 2 — grid pre-flight (G-rules).
 
-Statically validate the full 2x2x3x6x3 = 216-config grid against the
-implemented kernel registry BEFORE a multi-hour TPU run: a malformed
-config axis must fail in seconds on the host, not hours into an
-allocation (ISSUE 2 acceptance: reject a broken grid in <5s without
-touching a device — nothing in this module imports jax).
+Statically validate the full config grid (the paper's 2x2x3x6x3 = 216,
+but derived from config.py axes, NOT pinned — ROADMAP item 4 adds model
+families, and the pre-flight must not fight it) against the implemented
+kernel registry BEFORE a multi-hour TPU run: a malformed config axis
+must fail in seconds on the host, not hours into an allocation (ISSUE 2
+acceptance: reject a broken grid in <5s without touching a device —
+nothing in this module imports jax).
 
 Checks, each its own rule id:
 
-- G101 grid-shape: five non-empty dict axes; the paper grid multiplies
-  out to exactly 216 configs.
+- G101 grid-shape: five non-empty dict axes; the axes multiply out to
+  the same count ``config.iter_config_keys()`` enumerates (the default
+  expected size derives from the enumeration, so adding an axis value
+  in config.py moves BOTH sides together and a *skew* between the axes
+  and the enumeration is what actually fires).
 - G102 kernel-registry: preprocessing/balancing codes are EXACTLY
   ``range(len(axis))`` — they index ``lax.switch`` branch tuples, so a
   gap or duplicate silently runs the wrong kernel (worse than a crash);
@@ -100,9 +105,27 @@ KNOBS = {
     "F16_FEATURE_QUOTA": ("enum", ("sklearn", "informative")),
     "F16_PREDICT_WINDOW": ("int", 1),
     "F16_PREDICT_IMPL": ("enum", ("gather", "windows")),
+    # f16audit device budget (ISSUE 13): when set (MB), the sweep's plan
+    # pre-flight refuses any family program whose peak-memory envelope
+    # exceeds it (parallel/sweep._preflight_plan_budget, I401).
+    "F16_DEVICE_BUDGET_MB": ("float", 0.0),
 }
 
+# The PAPER's grid size — historical reference only. The pre-flight's
+# default expectation is derived from config.iter_config_keys() (see
+# default_grid_size), so growing the grid (ROADMAP item 4) needs no edit
+# here; tests that want the paper's exact grid pass expected_size=216.
 PAPER_GRID_SIZE = 216
+
+
+def default_grid_size():
+    """The config count the package's own enumeration yields — what the
+    planner, the sweep, and the audit census all iterate. Deriving the
+    G101 expectation from it (instead of pinning 216) turns the check
+    into axes-vs-enumeration consistency."""
+    from flake16_framework_tpu import config as cfg
+
+    return len(list(cfg.iter_config_keys()))
 
 
 def _finding(rule_id, message, path="flake16_framework_tpu/config.py",
@@ -151,7 +174,7 @@ def preflight_grid(axes=None, *, n_features=None, expected_size=None,
         from flake16_framework_tpu import config as cfg
 
         axes = cfg.GRID_AXES
-        expected_size = (PAPER_GRID_SIZE if expected_size is None
+        expected_size = (default_grid_size() if expected_size is None
                          else expected_size)
     if n_features is None:
         from flake16_framework_tpu.constants import N_FEATURES

@@ -1,0 +1,339 @@
+"""Rule pack 4 — f16audit IR rules (I-rules, ISSUE 13).
+
+The static half of the first-silicon story: every contract the
+unattended TPU session relies on, proven on the CPU host in seconds by
+tracing the REAL entry points (analysis/ir.py) instead of reading
+source text. The pack registers its catalog with the lint engine (so
+``--rules``, baselines and fingerprints know the I-ids) but contributes
+no ``check_module``/``check_project`` — IR findings only come from the
+audit entry points (``audit`` verb / ``lint --ir``), because tracing
+requires jax and plain lint must not (test_analysis_never_imports_jax).
+
+Rules:
+
+- I101 (error): host-callback primitive (pure_callback / io_callback /
+  debug_callback) in a jit-reachable program — one host round-trip per
+  dispatch, the tunnel tax f16lint J101 only guesses at.
+- I102 (warning): the IR found a host callback that the J101 AST taint
+  heuristic did NOT flag in the entry's defining module — the
+  heuristic's ground-truth cross-check.
+- I201 (error): nondeterministic primitive (lax.rng_uniform) — breaks
+  the write-ahead journal's bit-identical resume contract.
+- I202 (error): 64-bit aval in the traced program — f64 promotion
+  drift; downcasts silently with x64 off, breaks bit-identical resume
+  with it on.
+- I301 (error): static dispatch census (#plans for the full grid,
+  parallel/planner.py) disagrees with the runtime
+  ``grid_dispatch_count`` the bench gate recorded — the planner's
+  one-program-per-family contract no longer holds.
+- I401 (error): a plan's peak-memory envelope (ir.peak_live_bytes)
+  exceeds the device budget (``F16_DEVICE_BUDGET_MB``) — the run would
+  OOM on silicon; refused pre-flight.
+- I501 (error): shard_map config-axis violation — an input/output
+  replicated over "config" or a collective gathering across it.
+
+Module-import contract: NOTHING here imports jax at module level; the
+finding builders import analysis/ir.py lazily.
+"""
+
+import glob
+import json
+import os
+
+from flake16_framework_tpu.analysis.engine import (
+    ERROR, WARNING, Finding, RuleInfo,
+)
+
+PACK_NAME = "ir"
+
+RULES = {r.id: r for r in (
+    RuleInfo("I101", ERROR,
+             "host-callback primitive in a traced program — one"
+             " device->host round-trip per dispatch"),
+    RuleInfo("I102", WARNING,
+             "IR ground truth found a host callback the J101 AST taint"
+             " heuristic missed in the defining module"),
+    RuleInfo("I201", ERROR,
+             "nondeterministic primitive in a traced program — breaks"
+             " bit-identical journal resume"),
+    RuleInfo("I202", ERROR,
+             "64-bit aval in a traced program — f64 promotion drift"
+             " under the x64-off sweep contract"),
+    RuleInfo("I301", ERROR,
+             "static dispatch census != runtime grid_dispatch_count —"
+             " the one-program-per-family planner contract drifted"),
+    RuleInfo("I401", ERROR,
+             "plan peak-memory envelope exceeds the device budget"
+             " (F16_DEVICE_BUDGET_MB) — would OOM; refused pre-flight"),
+    RuleInfo("I501", ERROR,
+             "shard_map config-axis sharding violation — replication or"
+             " collective gather across independent plan members"),
+)}
+
+# Where each traced entry's program is DEFINED — findings anchor there
+# so they are actionable in an editor, with the entry named in the
+# message and the fingerprint keyed on the entry (stable snippet).
+_SWEEP_PATH = "flake16_framework_tpu/parallel/sweep.py"
+_SERVE_PATH = "flake16_framework_tpu/serve/store.py"
+_SHAP_PATH = "flake16_framework_tpu/ops/treeshap.py"
+
+
+def _finding(rule_id, message, *, path, entry):
+    return Finding(rule_id, RULES[rule_id].severity, path, 0, 0,
+                   message, snippet=entry)
+
+
+# -- I3: static dispatch census (pure host, no jax) ---------------------
+
+
+def static_plans(*, n=120, n_folds=10, devices=1, tree_overrides=None):
+    """The full grid's execution plans — the static dispatch census is
+    ``len()`` of this. Host-only: planner and config import no jax, so
+    the census is printable on a machine with no backend at all."""
+    from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.parallel import planner
+
+    return planner.plan_grid(
+        list(cfg.iter_config_keys()), devices=devices, n=n,
+        n_folds=n_folds, tree_overrides=tree_overrides)
+
+
+def latest_bench_census(repo=None):
+    """(runtime grid_dispatch_count, grid_plans, grid_configs, path)
+    from the NEWEST committed BENCH_r*.json that carries the dispatch
+    census (BENCH_r08 onward), or None when no record does."""
+    repo = repo or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    best = None
+    for p in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        try:
+            with open(p) as fd:
+                obj = json.load(fd)
+        except (OSError, ValueError):
+            continue
+        # Committed records wrap the final metric under "parsed"
+        # (tools/recovery_watch.persist_bench_json); a raw bench line
+        # carries "detail" at top level — accept both.
+        parsed = obj.get("parsed") if isinstance(obj.get("parsed"),
+                                                 dict) else obj
+        detail = (parsed.get("detail") or {}) if isinstance(parsed,
+                                                            dict) else {}
+        count = detail.get("grid_dispatch_count")
+        if isinstance(count, (int, float)):
+            best = (int(count), detail.get("grid_plans"),
+                    detail.get("grid_configs"), os.path.basename(p))
+    return best
+
+
+def census_findings(plans=None, *, repo=None, runtime_count=None):
+    """I301: reconcile the static census against the runtime one.
+
+    ``runtime_count`` (when given, e.g. by bench.py's live audit stage)
+    wins; otherwise the newest committed BENCH_r*.json census is used.
+    Reconciliation semantics: the comparison only binds when the bench
+    record measured the SAME grid (its ``grid_configs`` equals the
+    current grid size) — a record predating a grid change is stale
+    evidence, reported as no finding (the next bench re-records it)."""
+    from flake16_framework_tpu import config as cfg
+
+    plans = static_plans() if plans is None else plans
+    static_n = len(plans)
+    grid_size = len(list(cfg.iter_config_keys()))
+    source = "caller"
+    if runtime_count is None:
+        rec = latest_bench_census(repo)
+        if rec is None:
+            return [], {"static": static_n, "runtime": None,
+                        "source": None, "match": None}
+        runtime_count, _plans_rec, rec_grid, source = rec
+        if rec_grid is not None and int(rec_grid) != grid_size:
+            return [], {"static": static_n, "runtime": int(runtime_count),
+                        "source": source, "match": None,
+                        "stale": f"bench measured a {rec_grid}-config "
+                                 f"grid; current grid is {grid_size}"}
+    findings = []
+    if int(runtime_count) != static_n:
+        findings.append(_finding(
+            "I301",
+            f"static dispatch census is {static_n} plan(s) for the "
+            f"{grid_size}-config grid but the runtime census "
+            f"({source}) measured {int(runtime_count)} dispatches — "
+            "the one-program-per-family contract drifted",
+            path=_SWEEP_PATH, entry="grid_dispatch_count"))
+    return findings, {"static": static_n, "runtime": int(runtime_count),
+                      "source": source,
+                      "match": int(runtime_count) == static_n}
+
+
+# -- per-program walkers -> findings ------------------------------------
+
+
+def program_findings(entry, closed, *, path):
+    """I101/I201/I202 findings for one traced program."""
+    from flake16_framework_tpu.analysis import ir
+
+    findings = []
+    for prim in ir.callback_sites(closed):
+        findings.append(_finding(
+            "I101", f"traced program {entry!r} contains host-callback "
+            f"primitive {prim!r} — a device->host round-trip per "
+            "dispatch", path=path, entry=f"{entry}:{prim}"))
+    for prim in ir.nondet_sites(closed):
+        findings.append(_finding(
+            "I201", f"traced program {entry!r} contains "
+            f"nondeterministic primitive {prim!r} — journal resume "
+            "would not be bit-identical", path=path,
+            entry=f"{entry}:{prim}"))
+    for prim, dtype in ir.wide_dtype_sites(closed):
+        findings.append(_finding(
+            "I202", f"traced program {entry!r}: {prim} produces a "
+            f"{dtype} value — 64-bit drift under the x64-off contract",
+            path=path, entry=f"{entry}:{prim}:{dtype}"))
+    return findings
+
+
+def sharding_findings(entry, closed, *, path=_SWEEP_PATH, axis="config"):
+    """I501 findings for one traced mesh program."""
+    from flake16_framework_tpu.analysis import ir
+
+    n_maps, problems = ir.shard_map_audit(closed, axis=axis)
+    findings = []
+    if n_maps == 0:
+        findings.append(_finding(
+            "I501", f"traced mesh program {entry!r} contains no "
+            "shard_map — the config axis is not sharded at all",
+            path=path, entry=f"{entry}:no-shard_map"))
+    for prob in problems:
+        findings.append(_finding(
+            "I501", f"traced mesh program {entry!r}: {prob}",
+            path=path, entry=f"{entry}:{prob[:48]}"))
+    return findings
+
+
+def budget_findings(entry, envelope, *, budget_mb, path=_SWEEP_PATH):
+    """I401 finding when one program's envelope exceeds the budget."""
+    if not budget_mb or budget_mb <= 0:
+        return []
+    peak_mb = envelope["peak_bytes"] / 2**20
+    if peak_mb <= budget_mb:
+        return []
+    return [_finding(
+        "I401", f"plan program {entry!r} peak-memory envelope "
+        f"{peak_mb:.1f} MB exceeds the device budget {budget_mb:g} MB "
+        "(F16_DEVICE_BUDGET_MB) — would OOM on dispatch",
+        path=path, entry=f"{entry}:budget")]
+
+
+def crosscheck_findings(entry, closed, *, source_path):
+    """I102: the J101 taint heuristic's ground-truth cross-check. When
+    the IR proves a host callback exists in ``entry`` but the AST pack
+    raises no J101-family finding in the program's defining module, the
+    heuristic has a blind spot worth a warning (the reverse direction —
+    AST flags, IR clean — is already a hard lint failure and cannot
+    coexist with a green gate)."""
+    from flake16_framework_tpu.analysis import ir
+    from flake16_framework_tpu.analysis import rules_jax
+    from flake16_framework_tpu.analysis.engine import Module
+
+    prims = ir.callback_sites(closed)
+    if not prims:
+        return []
+    try:
+        ast_findings = rules_jax.check_module(Module(source_path))
+    except OSError:
+        return []
+    taint_rules = {"J101", "J102", "J103", "J104"}
+    if any(f.rule in taint_rules for f in ast_findings):
+        return []
+    return [_finding(
+        "I102", f"IR ground truth: {entry!r} reaches host callback(s) "
+        f"{prims} but the J101 taint heuristic reports nothing in "
+        f"{source_path} — heuristic blind spot", path=source_path,
+        entry=f"{entry}:crosscheck")]
+
+
+# -- the whole audit ----------------------------------------------------
+
+
+def run_audit(*, n=120, n_trees=2, n_folds=10, n_projects=26,
+              max_depth=8, budget_mb=None, repo=None, mesh=True,
+              runtime_count=None):
+    """Trace every real entry point and run every I-rule. Returns
+    (findings, info): ``info`` carries the census reconciliation, the
+    per-plan memory-envelope table (the ``prof_fit --audit`` payload)
+    and the traced-entry list. Shape defaults mirror the bench's
+    dispatch-census stage (n=120, trees=2, max_depth=8) so the static
+    and runtime censuses describe the same programs."""
+    from flake16_framework_tpu.analysis import ir
+
+    if budget_mb is None:
+        raw = os.environ.get("F16_DEVICE_BUDGET_MB", "")
+        budget_mb = float(raw) if raw else None
+
+    tree_overrides = {"Random Forest": n_trees, "Extra Trees": n_trees}
+    plans = static_plans(n=n, n_folds=n_folds,
+                         tree_overrides=tree_overrides)
+    findings, census = census_findings(plans, repo=repo,
+                                       runtime_count=runtime_count)
+    info = {"census": census, "envelopes": [], "entries": []}
+
+    def one(entry, closed, *, path, source_path=None, envelope=False,
+            batch=None):
+        info["entries"].append(entry)
+        findings.extend(program_findings(entry, closed, path=path))
+        findings.extend(crosscheck_findings(
+            entry, closed, source_path=source_path or path))
+        if envelope:
+            env = ir.memory_envelope(closed)
+            env.update(entry=entry, batch=batch,
+                       peak_mb=round(env["peak_bytes"] / 2**20, 2))
+            info["envelopes"].append(env)
+            findings.extend(budget_findings(entry, env,
+                                            budget_mb=budget_mb))
+
+    for pl in plans:
+        entry = f"scores.plan_batch[{'/'.join(pl.family)}]"
+        closed = ir.trace_plan_program(pl, mesh=None,
+                                       n_projects=n_projects,
+                                       max_depth=max_depth)
+        one(entry, closed, path=_SWEEP_PATH, envelope=True,
+            batch=pl.batch)
+
+    if mesh:
+        amesh = ir.audit_mesh()
+        for pl in plans:
+            entry = f"scores.plan_batch.mesh[{'/'.join(pl.family)}]"
+            closed = ir.trace_plan_program(pl, mesh=amesh,
+                                           n_projects=n_projects,
+                                           max_depth=max_depth)
+            info["entries"].append(entry)
+            findings.extend(program_findings(entry, closed,
+                                             path=_SWEEP_PATH))
+            findings.extend(sharding_findings(entry, closed))
+
+    serve = serve_entries(n_trees=max(n_trees, 2))
+    for entry, (fn, args, kwargs) in serve.items():
+        closed = ir.trace_entry(fn, args, kwargs)
+        one(entry, closed, path=_SERVE_PATH)
+
+    for entry, (fn, args, kwargs) in ir.shap_kernel_entries(
+            n_trees=max(n_trees, 2), depth=max_depth).items():
+        closed = ir.trace_entry(fn, args, kwargs)
+        one(entry, closed, path=_SHAP_PATH)
+
+    findings.sort(key=lambda f: (f.rule, f.path, f.snippet))
+    info["budget_mb"] = budget_mb
+    return findings, info
+
+
+def serve_entries(*, n_trees=2, max_nodes=64, n_cols=16, bucket=32,
+                  depth=8):
+    """The serving layer's AOT entry points as abstract (fn, args,
+    kwargs) handles (serve/store.ExecutableStore.audit_handles) — what
+    every live request dispatches through, traced without a registry or
+    a compile."""
+    from flake16_framework_tpu.serve.store import ExecutableStore
+
+    store = ExecutableStore(None)
+    return store.audit_handles(n_trees=n_trees, max_nodes=max_nodes,
+                               n_cols=n_cols, bucket=bucket, depth=depth)

@@ -113,6 +113,20 @@ def _cost_totals(compiled):
     return flops, bytes_
 
 
+def _abstractify(tree):
+    """Every array-like leaf reduced to a jax.ShapeDtypeStruct; python
+    scalars and statics pass through — a real-buffer pytree becomes the
+    abstract twin the audit can re-trace without device memory."""
+    def leaf(v):
+        shape = getattr(v, "shape", None)
+        dtype = getattr(v, "dtype", None)
+        if shape is not None and dtype is not None:
+            return jax.ShapeDtypeStruct(tuple(shape), dtype)
+        return v
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
 class AotExecutableCache:
     """Signature-keyed AOT executable store around one jitted callable.
 
@@ -129,6 +143,7 @@ class AotExecutableCache:
         self._static = frozenset(static_argnames)
         self._gate = gate_on_telemetry
         self._cache = {}  # signature -> compiled executable | None (bad)
+        self._warmed = {}  # signature -> (abstract args, abstract kwargs)
         self._lock = threading.Lock()
         # Optional (args, kwargs) -> dict of extra ``cost``-event fields,
         # evaluated per compile (ISSUE 9: the tree grower attaches its
@@ -140,6 +155,22 @@ class AotExecutableCache:
 
     def __getattr__(self, attr):
         return getattr(self._jfn, attr)
+
+    def traceable(self):
+        """(jitted fn, sorted static argnames) — the f16audit handle
+        (analysis/ir.trace_entry). Tracing the underlying jfn directly
+        keeps the audit OUT of the dispatch census: ``__call__`` counts
+        device dispatches (bench's grid_dispatch_count contract), and an
+        abstract trace is not one."""
+        return self._jfn, tuple(sorted(self._static))
+
+    def abstract_warmed(self):
+        """{signature: (abstract args, abstract kwargs)} for every warmed
+        signature — each dynamic leaf reduced to a ShapeDtypeStruct, the
+        exact shapes the serving layer pre-compiled, re-traceable by the
+        audit without real buffers."""
+        with self._lock:
+            return dict(self._warmed)
 
     def signature(self, args, kwargs):
         """Hashable dispatch key — (static kwargs repr, input tree
@@ -210,6 +241,9 @@ class AotExecutableCache:
             compiled = self._compile(args, kwargs)
             with self._lock:
                 self._cache[sig] = compiled
+        with self._lock:
+            self._warmed[sig] = (_abstractify(args),
+                                 _abstractify(kwargs))
         return sig
 
     def __call__(self, *args, **kwargs):

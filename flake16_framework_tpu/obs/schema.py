@@ -28,6 +28,10 @@ REPORT_SCHEMA = "flake16-report-v1"
 # .to_report) — a member of this same schema family so the drift lint
 # validates its own reports (analysis/rules_obs.check_json_file).
 LINT_SCHEMA = "flake16-lint-report-v1"
+# The f16audit ``audit --json`` document (analysis/cli.audit_report):
+# IR-level findings plus the dispatch-census reconciliation and the
+# per-plan memory-envelope table.
+AUDIT_SCHEMA = "flake16-audit-report-v1"
 
 _NUM = (int, float)
 
@@ -96,6 +100,11 @@ REPORT_SPAN_FIELDS = {"n", "cold_n", "total_s", "compile_est_s", "execute_s"}
 
 LINT_FIELDS = {"schema": str, "findings": list, "counts": dict,
                "rules": dict}
+AUDIT_FIELDS = {"schema": str, "findings": list, "counts": dict,
+                "census": dict, "envelopes": list, "entries": list}
+AUDIT_CENSUS_FIELDS = ("static", "runtime", "match")
+AUDIT_ENVELOPE_FIELDS = ("entry", "arg_bytes", "out_bytes", "peak_bytes",
+                         "peak_mb")
 LINT_FINDING_FIELDS = {"rule": str, "severity": str, "path": str,
                        "line": int, "col": int, "message": str}
 LINT_COUNT_FIELDS = ("errors", "warnings", "suppressed_inline",
@@ -159,6 +168,40 @@ def validate_lint_report(obj):
             if not isinstance(counts.get(name), int):
                 problems.append(
                     f"lint report: counts[{name!r}] missing or not int")
+    return problems
+
+
+def validate_audit_report(obj):
+    """Problems with one ``audit --json`` document (empty list = valid)."""
+    problems = []
+    if not isinstance(obj, dict):
+        return [f"audit report is {type(obj).__name__}, want object"]
+    _check_fields(obj, AUDIT_FIELDS, problems, "audit report")
+    if obj.get("schema") != AUDIT_SCHEMA:
+        problems.append(
+            f"audit report: schema {obj.get('schema')!r} != "
+            f"{AUDIT_SCHEMA!r}")
+    for i, f in enumerate(obj.get("findings") or ()):
+        if not isinstance(f, dict):
+            problems.append(f"audit report: findings[{i}] is not an object")
+            continue
+        _check_fields(f, LINT_FINDING_FIELDS, problems,
+                      f"audit report: findings[{i}]")
+    census = obj.get("census")
+    if isinstance(census, dict):
+        for name in AUDIT_CENSUS_FIELDS:
+            if name not in census:
+                problems.append(
+                    f"audit report: census missing {name!r}")
+    for i, env in enumerate(obj.get("envelopes") or ()):
+        if not isinstance(env, dict):
+            problems.append(
+                f"audit report: envelopes[{i}] is not an object")
+            continue
+        missing = set(AUDIT_ENVELOPE_FIELDS) - set(env)
+        if missing:
+            problems.append(
+                f"audit report: envelopes[{i}] missing {sorted(missing)}")
     return problems
 
 

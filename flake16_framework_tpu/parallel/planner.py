@@ -1,4 +1,4 @@
-"""The sweep planner: the 216-config grid as a handful of execution plans.
+"""The sweep planner: the config grid as a handful of execution plans.
 
 PR 9 made the fit kernel 8x faster and the headline bench SLOWER
 (BENCH_r07 vs r05): per-config dispatch round-trips and engine
@@ -108,7 +108,8 @@ def plan_grid(configs, *, devices=1, n, n_folds, tree_overrides=None):
     for keys in configs:
         keys = tuple(keys)
         if keys not in index_of:
-            raise ValueError(f"config {keys!r} is not in the 216-config "
+            raise ValueError(f"config {keys!r} is not in the "
+                             f"{len(index_of)}-config "
                              f"grid; the planner cannot seed its RNG")
         if keys in seen:
             continue

@@ -56,6 +56,45 @@ from flake16_framework_tpu.resilience import (
 N_FOLDS = 10
 
 
+class PlanOverBudget(ValueError):
+    """A family plan's peak-memory envelope exceeds the configured device
+    budget (F16_DEVICE_BUDGET_MB) — raised by the pre-flight BEFORE any
+    plan dispatches, so an over-budget grid refuses on the host instead
+    of OOMing hours into an allocation (f16audit I401, ISSUE 13)."""
+
+
+def _preflight_plan_budget(plans, *, n_projects, max_depth, grower):
+    """The f16audit I401 gate as a hard sweep pre-flight: when
+    ``F16_DEVICE_BUDGET_MB`` is set, trace every plan's family program
+    abstractly (analysis/ir.py — no compile, no dispatch) and refuse the
+    whole sweep if any peak-liveness envelope exceeds the budget. A no-op
+    (and jax-import-free beyond what the sweep already paid) when the
+    knob is unset, so the bench's dispatch census stays untouched."""
+    raw = os.environ.get("F16_DEVICE_BUDGET_MB", "")
+    if not raw:
+        return
+    budget_mb = float(raw)
+    if budget_mb <= 0:
+        return
+    from flake16_framework_tpu.analysis import ir
+
+    over = []
+    for pl in plans:
+        closed = ir.trace_plan_program(
+            pl, mesh=None, n_projects=n_projects, max_depth=max_depth,
+            grower=grower)
+        env = ir.memory_envelope(closed)
+        peak_mb = env["peak_bytes"] / 2**20
+        if peak_mb > budget_mb:
+            over.append(f"{'/'.join(pl.family)} (batch={pl.batch}): "
+                        f"peak {peak_mb:.1f} MB")
+    if over:
+        raise PlanOverBudget(
+            f"plan pre-flight: {len(over)} of {len(plans)} family "
+            f"program(s) exceed the F16_DEVICE_BUDGET_MB={budget_mb:g} "
+            f"device budget: " + "; ".join(over))
+
+
 def executor_scope(fn):
     """Marks plan-executor scope for f16lint's G107 rule
     (analysis/rules_grid.py): inside these functions a Python loop that
@@ -1351,6 +1390,9 @@ class SweepEngine:
                      else 1),
             n=self.features.shape[0], n_folds=self.n_folds,
             tree_overrides=self.tree_overrides)
+        _preflight_plan_budget(
+            plans, n_projects=len(self.project_names),
+            max_depth=self.max_depth, grower=self.grower)
         for pl in plans:
             def plan_thunk(pl=pl):
                 with rladder.device_context():

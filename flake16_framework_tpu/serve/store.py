@@ -176,6 +176,34 @@ class ExecutableStore:
             json.dump(manifest, fd, indent=1, sort_keys=True)
         return manifest
 
+    def audit_handles(self, *, n_trees, max_nodes, n_cols, bucket, depth):
+        """{entry name: (traceable fn, abstract args, static kwargs)} for
+        every serving executable — the f16audit trace surface
+        (analysis/rules_ir.serve_entries). Uses the caches'
+        ``traceable()`` handles so the audit never touches the dispatch
+        census, and abstract (ShapeDtypeStruct) artifact shapes so no
+        registry, buffer, or compile is needed. The pallas arm is
+        included whenever the cache exists (TPU); on CPU the xla arm IS
+        the served program, and the pallas kernel body is audited via
+        its interpret-mode entry (rules_ir's shap.pallas)."""
+        from flake16_framework_tpu.analysis import ir
+
+        forest = ir.abstract_forest(n_trees, max_nodes)
+        S = jax.ShapeDtypeStruct
+        mu = S((n_cols,), jax.numpy.float32)
+        wmat = S((n_cols, n_cols), jax.numpy.float32)
+        x = S((bucket, n_cols), jax.numpy.float32)
+        args = (forest, mu, wmat, x)
+        out = {
+            "serve.predict": (self._predict.traceable()[0], args, {}),
+            "serve.shap_xla": (self._shap_xla.traceable()[0], args,
+                               {"depth": depth}),
+        }
+        if self._shap_pallas is not None:
+            out["serve.shap_pallas"] = (
+                self._shap_pallas.traceable()[0], args, {"depth": depth})
+        return out
+
     # -- dispatch --------------------------------------------------------
 
     def call(self, model, kind, x):

@@ -1,0 +1,302 @@
+"""f16audit — the jaxpr/IR-level program auditor (ISSUE 13).
+
+Covers: every I-rule fires on a seeded IR fixture (a callback-bearing
+program, a deliberately nondeterministic program, an f64 program, an
+over-budget plan, a mis-sharded mesh program, a census mismatch); the
+memory-envelope liveness walk; the static-vs-runtime dispatch census
+reconciliation against the committed BENCH_r08 record; the sweep's
+hard budget pre-flight (PlanOverBudget); the obs/aot traceable-handle
+contract (tracing must NOT bump the dispatch census); and the CI gate:
+``python -m flake16_framework_tpu audit --json`` exits 0 on the package
+with a census that matches the benched grid_dispatch_count.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from flake16_framework_tpu.analysis import ir, rules_ir  # noqa: E402
+from flake16_framework_tpu.obs import schema  # noqa: E402
+
+S = jax.ShapeDtypeStruct
+
+
+def _callback_program():
+    def fn(x):
+        return jax.pure_callback(
+            lambda a: a, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    return jax.make_jaxpr(jax.jit(fn))(S((4,), jnp.float32))
+
+
+# -- walkers on seeded fixtures -----------------------------------------
+
+
+def test_i101_callback_program_fires():
+    closed = _callback_program()
+    assert ir.callback_sites(closed) == ["pure_callback"]
+    findings = rules_ir.program_findings("fix.cb", closed, path="p.py")
+    assert [f.rule for f in findings] == ["I101"]
+    assert "pure_callback" in findings[0].message
+
+
+def test_i201_nondeterministic_program_fires():
+    # f32 bounds: conftest turns x64 on, and bare python floats would
+    # otherwise also (correctly) trip I202 and muddy this fixture
+    def fn(x):
+        return x + jax.lax.rng_uniform(
+            jnp.float32(0), jnp.float32(1), x.shape)
+
+    closed = jax.make_jaxpr(jax.jit(fn))(S((3,), jnp.float32))
+    assert ir.nondet_sites(closed) == ["rng_uniform"]
+    findings = rules_ir.program_findings("fix.rng", closed, path="p.py")
+    assert [f.rule for f in findings] == ["I201"]
+
+
+def test_i202_wide_dtype_program_fires():
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        closed = jax.make_jaxpr(lambda x: x * 2.0)(S((4,), jnp.float64))
+    sites = ir.wide_dtype_sites(closed)
+    assert ("<input>", "float64") in sites
+    findings = rules_ir.program_findings("fix.f64", closed, path="p.py")
+    assert "I202" in {f.rule for f in findings}
+
+
+def test_clean_program_is_clean():
+    closed = jax.make_jaxpr(jax.jit(lambda x: (x * 2).sum()))(
+        S((8,), jnp.float32))
+    assert ir.callback_sites(closed) == []
+    assert ir.nondet_sites(closed) == []
+    assert ir.wide_dtype_sites(closed) == []
+    assert rules_ir.program_findings("fix.ok", closed, path="p.py") == []
+
+
+def test_i102_crosscheck_fires_on_ast_blind_spot(tmp_path):
+    """IR finds a callback; the defining module shows the J101 AST taint
+    heuristic nothing — the ground-truth cross-check warns."""
+    clean_src = tmp_path / "innocent.py"
+    clean_src.write_text("import jax\n\ndef f(x):\n    return x\n")
+    closed = _callback_program()
+    findings = rules_ir.crosscheck_findings(
+        "fix.cb", closed, source_path=str(clean_src))
+    assert [f.rule for f in findings] == ["I102"]
+    assert findings[0].severity == "warning"
+    # no callback in the IR -> no cross-check to make
+    clean = jax.make_jaxpr(lambda x: x + 1)(S((2,), jnp.float32))
+    assert rules_ir.crosscheck_findings(
+        "fix.ok", clean, source_path=str(clean_src)) == []
+
+
+def test_i301_census_mismatch_fires():
+    plans = rules_ir.static_plans(n=64)
+    findings, info = rules_ir.census_findings(
+        plans, runtime_count=len(plans) + 1)
+    assert [f.rule for f in findings] == ["I301"]
+    assert info["match"] is False
+    ok, info = rules_ir.census_findings(plans, runtime_count=len(plans))
+    assert ok == [] and info["match"] is True
+
+
+def test_i401_budget_findings():
+    env = {"arg_bytes": 0, "out_bytes": 0, "peak_bytes": 64 * 2**20}
+    over = rules_ir.budget_findings("fix.plan", env, budget_mb=1.0)
+    assert [f.rule for f in over] == ["I401"]
+    assert rules_ir.budget_findings("fix.plan", env, budget_mb=100.0) == []
+    assert rules_ir.budget_findings("fix.plan", env, budget_mb=None) == []
+
+
+def test_i501_sharding_violations_fire():
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax.experimental.shard_map import shard_map
+    except ImportError:
+        pytest.skip("no shard_map in this jax")
+    mesh = ir.audit_mesh()
+    # psum over the config axis + an output that drops the axis: both
+    # violations of the independent-plan-members contract
+    bad = shard_map(
+        lambda x: jax.lax.psum(x, "config"), mesh=mesh,
+        in_specs=P("config"), out_specs=P(), check_rep=False)
+    closed = jax.make_jaxpr(bad)(S((4, 8), jnp.float32))
+    n_maps, problems = ir.shard_map_audit(closed)
+    assert n_maps == 1
+    assert any("psum" in p for p in problems)
+    assert any("drops the 'config' axis" in p for p in problems)
+    findings = rules_ir.sharding_findings("fix.mesh", closed)
+    assert {f.rule for f in findings} == {"I501"}
+
+    good = shard_map(
+        lambda x: x * 2, mesh=mesh, in_specs=P("config"),
+        out_specs=P("config"), check_rep=False)
+    closed = jax.make_jaxpr(good)(S((4, 8), jnp.float32))
+    assert ir.shard_map_audit(closed) == (1, [])
+    assert rules_ir.sharding_findings("fix.mesh", closed) == []
+
+
+def test_i501_no_shard_map_is_a_finding():
+    closed = jax.make_jaxpr(lambda x: x + 1)(S((2,), jnp.float32))
+    findings = rules_ir.sharding_findings("fix.nomesh", closed)
+    assert [f.rule for f in findings] == ["I501"]
+    assert "no shard_map" in findings[0].message
+
+
+# -- memory envelope ----------------------------------------------------
+
+
+def test_memory_envelope_liveness_walk():
+    def fn(x):
+        a = x * 2          # n floats live alongside x
+        b = a + 1
+        return b.sum()
+
+    closed = jax.make_jaxpr(jax.jit(fn))(S((1024,), jnp.float32))
+    env = ir.memory_envelope(closed)
+    assert env["arg_bytes"] == 4096
+    assert env["out_bytes"] == 4
+    # peak: input + one intermediate live together (~2 buffers)
+    assert env["peak_bytes"] >= 2 * 4096 - 16
+    # and the walk frees dead buffers: far below "every var lives forever"
+    assert env["peak_bytes"] <= 4 * 4096
+
+
+def test_memory_envelope_handles_key_avals():
+    def fn(k):
+        key = jax.random.wrap_key_data(k)
+        return jax.random.normal(key, (16,))
+
+    closed = jax.make_jaxpr(jax.jit(fn))(S((2,), jnp.uint32))
+    assert ir.memory_envelope(closed)["peak_bytes"] > 0
+
+
+# -- census reconciliation (the acceptance criterion) --------------------
+
+
+def test_static_census_matches_bench_r08():
+    """static census == runtime grid_dispatch_count (6) from BENCH_r08."""
+    plans = rules_ir.static_plans()
+    rec = rules_ir.latest_bench_census(REPO)
+    assert rec is not None, "no BENCH_r*.json carries a dispatch census"
+    runtime_count, grid_plans, _grid_configs, source = rec
+    assert runtime_count == 6 and source >= "BENCH_r08.json"
+    assert len(plans) == runtime_count
+    findings, info = rules_ir.census_findings(plans, repo=REPO)
+    assert findings == [] and info["match"] is True
+
+
+# -- sweep budget pre-flight --------------------------------------------
+
+
+def test_sweep_budget_preflight(monkeypatch):
+    from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.parallel import planner, sweep
+
+    plans = planner.plan_grid(
+        cfg.iter_config_keys(), n=64, n_folds=10,
+        tree_overrides={"Random Forest": 2, "Extra Trees": 2})
+    kw = dict(n_projects=26, max_depth=8, grower=None)
+    # unset knob: no-op (the bench's census path must stay untouched)
+    monkeypatch.delenv("F16_DEVICE_BUDGET_MB", raising=False)
+    sweep._preflight_plan_budget(plans, **kw)
+    # absurdly small budget: every plan is over; the sweep refuses
+    monkeypatch.setenv("F16_DEVICE_BUDGET_MB", "0.001")
+    with pytest.raises(sweep.PlanOverBudget, match="exceed"):
+        sweep._preflight_plan_budget(plans, **kw)
+    # generous budget: passes
+    monkeypatch.setenv("F16_DEVICE_BUDGET_MB", "100000")
+    sweep._preflight_plan_budget(plans, **kw)
+
+
+# -- obs/aot traceable handle -------------------------------------------
+
+
+def test_aot_traceable_does_not_bump_dispatch_census():
+    from flake16_framework_tpu.obs import aot
+
+    cache = aot.AotExecutableCache(
+        jax.jit(lambda x: x * 2), "audit.test", gate_on_telemetry=False)
+    before = aot.dispatch_stats()["dispatches"]
+    closed = ir.trace_entry(cache, (S((4,), jnp.float32),))
+    assert aot.dispatch_stats()["dispatches"] == before
+    assert ir.callback_sites(closed) == []
+    # a real __call__ DOES count — the census contract is unchanged
+    cache(jnp.ones((4,), jnp.float32))
+    assert aot.dispatch_stats()["dispatches"] == before + 1
+
+
+def test_aot_abstract_warmed_records_shapes():
+    from flake16_framework_tpu.obs import aot
+
+    cache = aot.AotExecutableCache(
+        jax.jit(lambda x: x + 1), "audit.warm", gate_on_telemetry=False)
+    sig = cache.warm(np.zeros((8, 3), np.float32))
+    assert sig is not None
+    warmed = cache.abstract_warmed()
+    (args, kwargs) = warmed[sig]
+    assert isinstance(args[0], jax.ShapeDtypeStruct)
+    assert args[0].shape == (8, 3) and kwargs == {}
+    # the recorded abstract args re-trace without real buffers
+    closed = ir.trace_entry(cache, args, kwargs)
+    assert ir.nondet_sites(closed) == []
+
+
+# -- serve entry points --------------------------------------------------
+
+
+def test_serve_audit_handles_trace_clean():
+    handles = rules_ir.serve_entries(n_trees=2, max_nodes=16, n_cols=4,
+                                     bucket=8, depth=3)
+    assert "serve.predict" in handles and "serve.shap_xla" in handles
+    for entry, (fn, args, kwargs) in handles.items():
+        closed = ir.trace_entry(fn, args, kwargs)
+        assert ir.callback_sites(closed) == [], entry
+        assert ir.nondet_sites(closed) == [], entry
+
+
+# -- pack registration ---------------------------------------------------
+
+
+def test_ir_pack_registered_in_catalog():
+    from flake16_framework_tpu.analysis.cli import build_engine
+
+    rules = build_engine().rules
+    for rid in ("I101", "I102", "I201", "I202", "I301", "I401", "I501"):
+        assert rid in rules
+    # but the pack contributes NO AST hooks: plain lint stays jax-free
+    assert not hasattr(rules_ir, "check_module")
+    assert not hasattr(rules_ir, "check_project")
+
+
+# -- the CI gate (tier-1): the package audits clean ----------------------
+
+
+def test_audit_gate_package_is_clean():
+    """The ISSUE 13 acceptance bar, run exactly as an operator would:
+    ``python -m flake16_framework_tpu audit --json`` exits 0, the static
+    dispatch census matches the benched grid_dispatch_count (6), and the
+    report document is schema-valid."""
+    r = subprocess.run(
+        [sys.executable, "-m", "flake16_framework_tpu", "audit", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-1000:]
+    report = json.loads(r.stdout)
+    assert schema.validate_audit_report(report) == []
+    assert report["findings"] == []
+    assert report["census"]["static"] == 6
+    assert report["census"]["runtime"] == 6
+    assert report["census"]["match"] is True
+    assert len(report["envelopes"]) == 6
+    for env in report["envelopes"]:
+        assert env["peak_bytes"] > env["arg_bytes"] >= 0

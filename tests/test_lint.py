@@ -23,6 +23,7 @@ PACKAGE = os.path.join(REPO, "flake16_framework_tpu")
 from flake16_framework_tpu.analysis import (  # noqa: E402
     Engine, Module, load_baseline, save_baseline,
 )
+from flake16_framework_tpu.analysis import engine as eng_mod  # noqa: E402
 from flake16_framework_tpu.analysis import rules_grid  # noqa: E402
 from flake16_framework_tpu.analysis.cli import (  # noqa: E402
     PACKS, lint_main, run_lint,
@@ -152,8 +153,56 @@ def test_gen_lint_baseline_tool(tmp_path):
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr[-500:]
     obj = json.load(open(out))
-    assert obj["schema"] == "flake16-lint-baseline-v1"
-    assert len(obj["fingerprints"]) == len(EXPECTED_FIXTURE_RULES)
+    assert obj["schema"] == "flake16-lint-baseline-v2"
+    fps = [fp for fp_list in obj["packs"].values() for fp in fp_list]
+    assert len(fps) == len(EXPECTED_FIXTURE_RULES)
+    # per-pack sections group by rule-id prefix
+    for pack, fp_list in obj["packs"].items():
+        for fp in fp_list:
+            assert eng_mod.pack_of(fp.split(":", 1)[0]) == pack
+
+
+def test_gen_lint_baseline_per_pack_regen(tmp_path):
+    """--pack NAME regenerates only that pack's section; other packs'
+    fingerprints survive verbatim (the silent-drop fix, ISSUE 13)."""
+    out = str(tmp_path / "b.json")
+    tool = os.path.join(REPO, "tools", "gen_lint_baseline.py")
+    r = subprocess.run(
+        [sys.executable, tool, FIXTURE, "--out", out],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-500:]
+    before = json.load(open(out))
+    assert "jax" in before["packs"] and "obs" in before["packs"]
+    # regenerate ONLY the obs pack against an empty dir: obs section
+    # empties out, jax section survives untouched
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    r = subprocess.run(
+        [sys.executable, tool, str(empty), "--out", out, "--pack", "obs"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-500:]
+    after = json.load(open(out))
+    assert after["packs"]["jax"] == before["packs"]["jax"]
+    assert "obs" not in after["packs"]
+
+
+def test_baseline_v1_back_compat_and_unknown_rule_rejection(tmp_path):
+    """v1 flat-list baselines still load; a fingerprint naming a rule id
+    unknown to the catalog raises instead of silently absorbing
+    nothing."""
+    v1 = tmp_path / "v1.json"
+    v1.write_text(json.dumps({
+        "schema": "flake16-lint-baseline-v1",
+        "fingerprints": ["J401:deadbeefdeadbeef"]}))
+    rules = Engine(PACKS).rules
+    assert load_baseline(str(v1), rules=rules) == [
+        "J401:deadbeefdeadbeef"]
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({
+        "schema": "flake16-lint-baseline-v2",
+        "packs": {"jax": ["J999:deadbeefdeadbeef"]}}))
+    with pytest.raises(ValueError, match="J999"):
+        load_baseline(str(bad), rules=rules)
 
 
 # -- engine mechanics ---------------------------------------------------

@@ -1,20 +1,32 @@
 """Regenerate an f16lint baseline file from the current findings.
 
     python tools/gen_lint_baseline.py [PATHS...] [--out FILE]
+        [--pack NAME]
 
 Runs the full f16lint rule set (inline suppressions still apply — a
 baseline records what inline comments do NOT already silence) over PATHS
 (default: the package, like the CI gate) and writes the finding
-fingerprints to FILE (default tools/lint_baseline.json). Re-linting with
-``--baseline FILE`` then exits 0 until NEW findings appear — the
-ratchet workflow for adopting a rule on a codebase with existing debt
-(PROFILE.md "Static analysis" > baseline workflow).
+fingerprints to FILE (default tools/lint_baseline.json) in the v2
+per-pack schema. Re-linting with ``--baseline FILE`` then exits 0 until
+NEW findings appear — the ratchet workflow for adopting a rule on a
+codebase with existing debt (PROFILE.md "Static analysis" > baseline
+workflow).
+
+``--pack NAME`` (jax | grid | obs | ir | engine) regenerates ONLY that
+pack's section, preserving every other pack's fingerprints verbatim —
+the fix for the silent-drop bug: a full flat-list regeneration run
+before a new rule pack landed would re-record the whole world and, being
+schema-v1, could later absorb findings from packs it never saw. v2
+baselines are per-pack, and loading one that names a rule id unknown to
+the catalog fails loudly (engine.load_baseline) instead of suppressing
+nothing.
 
 The repo itself ships with zero findings and no checked-in baseline (the
 dogfood bar: ISSUE 2 acceptance); this tool exists for downstream forks
 and for staging new rules.
 """
 
+import json
 import os
 import sys
 
@@ -22,13 +34,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from flake16_framework_tpu.analysis import engine as eng  # noqa: E402
-from flake16_framework_tpu.analysis.cli import run_lint  # noqa: E402
+from flake16_framework_tpu.analysis.cli import build_engine, run_lint  # noqa: E402
 
 DEFAULT_OUT = os.path.join(REPO, "tools", "lint_baseline.json")
 
 
 def main(argv):
     out_file = DEFAULT_OUT
+    pack = None
     paths = []
     it = iter(argv)
     for a in it:
@@ -36,15 +49,49 @@ def main(argv):
             out_file = next(it, None)
             if out_file is None:
                 raise ValueError("--out needs a file argument")
+        elif a == "--pack":
+            pack = next(it, None)
+            if pack is None:
+                raise ValueError("--pack needs a pack name argument")
+            if pack not in eng.PACK_PREFIXES.values():
+                raise ValueError(
+                    f"unknown pack {pack!r} (known: "
+                    f"{sorted(eng.PACK_PREFIXES.values())})")
         elif a.startswith("--"):
             raise ValueError(f"Unrecognized option {a!r}")
         else:
             paths.append(a)
 
+    # Validate any existing baseline against the live catalog FIRST: a
+    # stale fingerprint (renamed/removed rule) must fail the regen, not
+    # ride along silently.
+    catalog = build_engine().rules
+    eng.load_baseline(out_file if os.path.exists(out_file) else None,
+                      rules=catalog)
+
     result = run_lint(paths or None)
-    eng.save_baseline(out_file, result.findings)
-    print(f"wrote {len(result.findings)} fingerprint(s) to {out_file}")
-    for f in result.findings:
+    findings = result.findings
+    keep = None
+    if pack is not None:
+        findings = [f for f in findings if eng.pack_of(f.rule) == pack]
+        keep = {}
+        if os.path.exists(out_file):
+            with open(out_file) as fd:
+                obj = json.load(fd)
+            if obj.get("schema") == eng.BASELINE_SCHEMA:
+                keep = {p: fps for p, fps in obj.get("packs", {}).items()
+                        if p != pack}
+            # v1 flat lists cannot be split per-pack; the rule-id prefix
+            # in each fingerprint recovers the grouping.
+            elif obj.get("schema") == eng.BASELINE_SCHEMA_V1:
+                for fp in obj.get("fingerprints", []):
+                    p = eng.pack_of(fp.split(":", 1)[0])
+                    if p != pack:
+                        keep.setdefault(p, []).append(fp)
+    eng.save_baseline(out_file, findings, keep_packs=keep)
+    scope = f"pack {pack!r}" if pack else "all packs"
+    print(f"wrote {len(findings)} fingerprint(s) ({scope}) to {out_file}")
+    for f in findings:
         print(f"  {f.fingerprint}  {f.render()}")
     return 0
 

@@ -3,16 +3,22 @@
     python tools/prof_fit.py [--n 400] [--trees 25] [--reps 2]
                              [--growers hist,exact] [--impls auto]
                              [--models DT,RF,ET] [--devices 1]
-                             [--engine-only] [--plan-only] [--json]
+                             [--engine-only] [--plan-only] [--audit]
+                             [--json]
 
 Four measurement layers, cheapest-first (all timed layers steady-state:
 every timed call runs once untimed to absorb compiles):
 
-0. **Plan table** — the planner's grouping of the full 216-config grid
+0. **Plan table** — the planner's grouping of the full config grid
    at this shape (parallel/planner.py, ISSUE 12): per plan the family,
    member count, padded batch and pad-waste %, so padding overhead is
    visible BEFORE a run. Pure host arithmetic — no jax import, no
    backend needed (``--plan-only`` works on a machine with neither).
+   ``--audit`` extends the table with each plan's f16audit memory
+   envelope (analysis/ir.py, ISSUE 13): abstract-trace the family
+   program (no compile, no dispatch) and print arg/out/peak-liveness
+   bytes plus the lowered cost model's flop count — the pre-flight
+   numbers a device budget is set against (F16_DEVICE_BUDGET_MB).
 1. **Engine walls** — ``SweepEngine.run_config`` per bench config
    (bench.py CONFIGS at the bench shape), the exact number the bench's
    ``t_ours_fit_s`` aggregates. Run per grower tier so hist-vs-exact is
@@ -69,6 +75,41 @@ def plan_report(n_tests, n_trees, devices, n_folds=10):
         cfg.iter_config_keys(), devices=devices, n=n_tests,
         n_folds=n_folds, tree_overrides=overrides)
     return planner.plan_table(plans), planner.format_plan_table(plans)
+
+
+def audit_report(n_tests, n_trees, n_folds=10, max_depth=48):
+    """The ``--audit`` layer: per-plan memory envelopes by abstract trace
+    (analysis/ir.py — imports jax, no compile, no device dispatch)."""
+    from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.analysis import ir
+    from flake16_framework_tpu.parallel import planner, sweep
+
+    overrides = {"Random Forest": n_trees, "Extra Trees": n_trees}
+    plans = planner.plan_grid(
+        cfg.iter_config_keys(), n=n_tests, n_folds=n_folds,
+        tree_overrides=overrides)
+    rows = []
+    for pl in plans:
+        closed = ir.trace_plan_program(pl, mesh=None, n_projects=26,
+                                       max_depth=max_depth)
+        env = ir.memory_envelope(closed)
+        _fs, model_name = pl.family
+        spec = cfg.MODELS[model_name]
+        n_tr = overrides.get(model_name, spec.n_trees)
+        spec = type(spec)(spec.name, n_tr, spec.bootstrap,
+                          spec.random_splits, spec.sqrt_features)
+        fn = sweep.make_plan_fn(
+            spec, None, n=pl.shape[0], n_feat=pl.shape[1], n_projects=26,
+            max_depth=max_depth, n_folds=pl.shape[3])
+        cost = ir.lowered_cost(fn, ir.abstract_plan_args(pl, n_projects=26))
+        rows.append({
+            "family": "/".join(pl.family), "batch": pl.batch,
+            "arg_mb": round(env["arg_bytes"] / 2**20, 2),
+            "out_mb": round(env["out_bytes"] / 2**20, 2),
+            "peak_mb": round(env["peak_bytes"] / 2**20, 2),
+            "gflops": round(cost.get("flops", 0.0) / 1e9, 3),
+        })
+    return rows
 
 
 def engine_walls(n_tests, n_trees, growers, models, reps):
@@ -177,6 +218,9 @@ def main(argv=None):
     ap.add_argument("--kernel-only", action="store_true")
     ap.add_argument("--plan-only", action="store_true",
                     help="print only the (host-side) plan table")
+    ap.add_argument("--audit", action="store_true",
+                    help="print the plan table with per-plan f16audit "
+                         "memory envelopes (abstract trace; no compile)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
@@ -191,6 +235,23 @@ def main(argv=None):
                   f"devices={args.devices}]")
             for line in plan_lines:
                 print(f"  {line}")
+        return 0
+
+    if args.audit:
+        rows = audit_report(args.n, args.trees)
+        if args.json:
+            print(json.dumps({"n_tests": args.n, "n_trees": args.trees,
+                              "plan_table": plan_rows,
+                              "audit": rows}, indent=1))
+        else:
+            print(f"[audit n={args.n} trees={args.trees}] "
+                  "(liveness-walk envelopes — upper bounds; "
+                  "see PROFILE.md 'IR audit')")
+            for r in rows:
+                print(f"  {r['family']:28s} batch={r['batch']:<4} "
+                      f"arg={r['arg_mb']:7.2f}MB out={r['out_mb']:6.2f}MB "
+                      f"peak={r['peak_mb']:7.2f}MB "
+                      f"gflops={r['gflops']:.3f}")
         return 0
 
     import jax

@@ -272,6 +272,28 @@ def chain():
             return False
     except (OSError, ValueError, IndexError):
         pass
+    # f16audit pre-flight (ISSUE 13): statically prove the dispatch,
+    # determinism, memory and sharding contracts on the CPU backend
+    # BEFORE the device window burns — an audit failure means the engine
+    # would ship a broken contract to first silicon (a host round-trip
+    # per dispatch, a nondeterministic journal, an over-budget plan), so
+    # it aborts the chain rather than spend the TPU budget measuring it.
+    # Pinned to JAX_PLATFORMS=cpu: the audit only traces, never
+    # dispatches, and must not hold the device.
+    ok_a, out_a, err_a = run_stage(
+        "audit", [py, "-m", "flake16_framework_tpu", "audit", "--json"],
+        900, env_extra={"JAX_PLATFORMS": "cpu"})
+    if out_a and "{" in out_a:
+        try:
+            with open(os.path.join(REPO, "_scratch", "audit_tpu.json"),
+                      "w") as fd:
+                fd.write(out_a[out_a.index("{"):])
+        except OSError:
+            pass
+    if not ok_a:
+        log("audit FAILED — contracts unproven; not burning the device "
+            "window (%s)" % (err_a or "").strip()[-200:])
+        return False
     # HEADLINE FIRST (learned 2026-07-31: a ~16 min up-window went entirely
     # to probes and the bench never touched the device before the next
     # wedge). The two north-star numbers — BENCH backend=tpu and
