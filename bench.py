@@ -88,6 +88,10 @@ def shap_tree_chunk_env():
     return int(raw) or None
 
 
+# Import-time snapshot kept for tooling back-compat (tools/probe_common
+# reads it); the worker consults shap_tree_chunk_env() LIVE at each
+# explain so a knob change (or the resilience ladder's halvings, applied
+# inside treeshap.forest_shap_class0) takes effect without a re-import.
 SHAP_TREE_CHUNK = shap_tree_chunk_env()
 # Fused single-dispatch mode: each config (or same-family batch) runs
 # prep+resample+fit+predict+score as ONE device program returning only
@@ -521,12 +525,45 @@ def worker(n_tests, n_trees):
             "grid_dispatch_count": dispatch_rec["grid_dispatch_count"],
         }), flush=True)
 
+        # SHAP dispatch census (ISSUE 14): same protocol for the
+        # planner's SHAP arm — a WHOLE-GRID explain pass (one fused
+        # prep->resample->fit->explain program per family,
+        # pipeline.shap_grid) warmed once, then delta'd. The structural
+        # count must equal #plans; shap_interact_s rides along as the
+        # warm whole-grid interaction-mode wall (the beyond-paper mode's
+        # trend metric, gated lower-is-better from BENCH_r09).
+        g_explain = int(os.environ.get("BENCH_SHAP_GRID_EXPLAIN", "16"))
+        shap_grid_kw = dict(arrays=(g_data[0], g_data[1]),
+                            n_explain=g_explain, max_depth=8,
+                            tree_overrides=g_engine.tree_overrides)
+        pipeline.shap_grid(**shap_grid_kw)  # warm: one compile per plan
+        before = _aot.dispatch_stats()
+        t0 = time.time()
+        pipeline.shap_grid(**shap_grid_kw)
+        t_sgrid = time.time() - t0
+        after = _aot.dispatch_stats()
+        pipeline.shap_grid(mode="interaction", **shap_grid_kw)  # warm
+        t0 = time.time()
+        pipeline.shap_grid(mode="interaction", **shap_grid_kw)
+        t_sint = time.time() - t0
+        shap_census_rec = {
+            "shap_dispatch_count": after["dispatches"]
+            - before["dispatches"],
+            "shap_grid_wall_s": round(t_sgrid, 3),
+            "shap_interact_s": round(t_sint, 3),
+            "shap_audit_census_match": (
+                static_n == after["dispatches"] - before["dispatches"]),
+        }
+        dispatch_rec.update(shap_census_rec)
+        print(json.dumps({"stage": "shap_census", **shap_census_rec}),
+              flush=True)
+
     # SHAP stage. Default impl "auto" = the Pallas kernel on TPU, XLA
     # elsewhere; BENCH_SHAP_IMPL overrides so a hardware A/B (hw_probe
     # tune_shap's xla arm) can ship its winner without a code change.
     n_explain = min(SHAP_EXPLAIN, n_tests)
     shap_kw = dict(tree_overrides=overrides, n_explain=n_explain,
-                   shap_tree_chunk=SHAP_TREE_CHUNK,
+                   shap_tree_chunk=shap_tree_chunk_env(),
                    fit_dispatch_trees=DISPATCH_TREES,
                    fused_fit=engine.fused,
                    impl=os.environ.get("BENCH_SHAP_IMPL", "auto"))
@@ -989,6 +1026,13 @@ def main():
         grid_dispatch_count=result.get("grid_dispatch_count"),
         grid_plans=result.get("grid_plans"),
         grid_configs=result.get("grid_configs"),
+        # SHAP-arm census (ISSUE 14): instrumented dispatches + walls of
+        # the whole-216-grid fused explain pass; shap_dispatch_count and
+        # shap_interact_s gate lower-is-better from BENCH_r09 on.
+        shap_dispatch_count=result.get("shap_dispatch_count"),
+        shap_grid_wall_s=result.get("shap_grid_wall_s"),
+        shap_interact_s=result.get("shap_interact_s"),
+        shap_audit_census_match=result.get("shap_audit_census_match"),
         # f16audit reconciliation (ISSUE 13): the planner's static
         # census and whether it matched the measured dispatch count —
         # False trips the audit gate (exit 3) after this record prints.
@@ -1020,6 +1064,12 @@ def main():
         print(f"AUDIT GATE: static census {detail['audit_static_census']}"
               f" != measured grid_dispatch_count "
               f"{detail['grid_dispatch_count']}", file=sys.stderr,
+              flush=True)
+        sys.exit(3)
+    if detail.get("shap_audit_census_match") is False:
+        print(f"AUDIT GATE: static census {detail['audit_static_census']}"
+              f" != measured shap_dispatch_count "
+              f"{detail['shap_dispatch_count']}", file=sys.stderr,
               flush=True)
         sys.exit(3)
 

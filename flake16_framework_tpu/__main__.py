@@ -11,6 +11,12 @@
 
 plus extension verbs the reference lacks:
 
+    python -m flake16_framework_tpu shap grid|interventional|interaction
+        [explain=N] [background=N]
+        # whole-216-grid SHAP through the planner's fused explain
+        # programs (<= #families + O(1) dispatches; pipeline.shap_grid):
+        # path-dependent values, interventional values vs a background
+        # set, or SHAP interaction values [F, F] per sample
     python -m flake16_framework_tpu report [RUN_DIR] [--json] [--attrib]
         # render a telemetry run (F16_TELEMETRY=1 during scores/shap/bench)
         # into per-stage compile/execute walls, throughput, memory peaks;
@@ -158,9 +164,41 @@ def main(argv=None):
                 f"{out_file} exists (run `scores` for a fresh sweep)")
         write_scores(**kw)
     elif command == "shap":
-        from flake16_framework_tpu.pipeline import write_shap
+        # Bare `shap` is the paper artifact (two reference configs ->
+        # shap.pkl, unchanged). Extension modes (ISSUE 14) run the WHOLE
+        # 216 grid through the planner's fused explain programs
+        # (pipeline.shap_grid, <= #families + O(1) dispatches):
+        #   shap grid           path-dependent Tree SHAP   -> shap-grid.pkl
+        #   shap interventional vs a background set        -> shap-interventional.pkl
+        #   shap interaction    interaction values [F, F]  -> shap-interaction.pkl
+        # with explain=N / background=N sizing the explain + background
+        # row counts (defaults 64 / 32).
+        mode = None
+        kw = {}
+        for a in args:
+            if a in ("grid", "interventional", "interaction"):
+                if mode is not None:
+                    raise ValueError("shap: give at most one mode")
+                mode = a
+            elif a.startswith("explain="):
+                kw["n_explain"] = int(a.split("=", 1)[1])
+            elif a.startswith("background="):
+                kw["n_background"] = int(a.split("=", 1)[1])
+            else:
+                raise ValueError(f"Unrecognized shap option {a!r}")
+        if mode is None:
+            if kw:
+                raise ValueError(
+                    "shap: explain=/background= need a mode "
+                    "(grid|interventional|interaction)")
+            from flake16_framework_tpu.pipeline import write_shap
 
-        write_shap()
+            write_shap()
+        else:
+            from flake16_framework_tpu.pipeline import shap_grid
+
+            engine_mode = "path" if mode == "grid" else mode
+            shap_grid(out_file=f"shap-{mode}.pkl", mode=engine_mode, **kw)
     elif command == "figures":
         from flake16_framework_tpu.figures.report import write_figures
 

@@ -129,6 +129,7 @@ def audit_report(findings, info):
                    "warnings": len(findings) - len(errors),
                    "entries": len(info["entries"])},
         "census": info["census"],
+        "shap_census": info.get("shap_census"),
         "envelopes": info["envelopes"],
         "entries": info["entries"],
         "budget_mb": info["budget_mb"],
@@ -171,10 +172,13 @@ def audit_main(args, out=None):
         for f in findings:
             out.write(f.render() + "\n")
         c = info["census"]
+        sc = info.get("shap_census") or {}
         out.write(
             f"audit: {len(info['entries'])} entr(ies) traced; census "
             f"static={c['static']} runtime={c['runtime']} "
-            f"({c['source']}); {len(findings)} finding(s)\n")
+            f"({c['source']}); shap census "
+            f"static={sc.get('static')} runtime={sc.get('runtime')} "
+            f"({sc.get('source')}); {len(findings)} finding(s)\n")
         for env in info["envelopes"]:
             out.write(
                 f"  {env['entry']:<44} batch={env['batch']:<4} "

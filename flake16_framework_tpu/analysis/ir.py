@@ -399,17 +399,67 @@ def abstract_forest(n_trees, max_nodes, n_classes=2):
 
 def shap_kernel_entries(*, n_trees=100, max_nodes=64, n_samples=32,
                         n_feat=16, depth=8):
-    """{name: (fn, args, kwargs)} for both SHAP kernels at one abstract
-    shape. The pallas kernel is traced with interpret=True so the audit
-    runs on hosts without a TPU backend — the jaxpr structure is the
-    same; only the backend lowering differs."""
+    """{name: (fn, args, kwargs)} for every SHAP engine program at one
+    abstract shape: the two ladder rungs of the path-dependent work-item
+    engine (xla in-graph program / pallas unit kernel on the in-graph
+    layout) plus both beyond-paper modes (ISSUE 14). The pallas kernel
+    is traced with interpret=True so the audit runs on hosts without a
+    TPU backend — the jaxpr structure is the same; only the backend
+    lowering differs."""
     from flake16_framework_tpu.ops import treeshap
 
     forest = abstract_forest(n_trees, max_nodes)
     x = jax.ShapeDtypeStruct((n_samples, n_feat), jnp.float32)
+    bg = jax.ShapeDtypeStruct((8, n_feat), jnp.float32)
     return {
         "shap.xla": (treeshap._xla_forest_shap, (forest, x),
                      {"depth": depth}),
-        "shap.pallas": (treeshap._pallas_forest_shap, (forest, x),
+        "shap.pallas": (treeshap._pallas_graph_shap, (forest, x),
                         {"depth": depth, "interpret": True}),
+        "shap.interventional": (treeshap._interventional_jit,
+                                (forest, x, bg),
+                                {"depth": depth, "row_chunk": 16}),
+        "shap.interactions": (treeshap._interactions_jit, (forest, x),
+                              {"depth": depth, "row_chunk": 16}),
     }
+
+
+def abstract_explain_plan_args(plan):
+    """The ShapeDtypeStruct argument tuple of one SHAP plan's program
+    (make_shap_plan_fn's plan_batch order): (x, y_raw, fls, preps, bals,
+    keys). The plan comes from planner.plan_explain_grid, whose shape
+    signature appends n_explain to the fit signature."""
+    n, n_feat = plan.shape[0], plan.shape[1]
+    batch = plan.batch
+    s = jax.ShapeDtypeStruct
+    return (
+        s((n, n_feat), jnp.float32),  # x (selected columns)
+        s((n,), jnp.int32),           # y_raw
+        s((batch,), jnp.int32),       # flaky labels
+        s((batch,), jnp.int32),       # prep codes
+        s((batch,), jnp.int32),       # bal codes
+        s((batch, 2), jnp.uint32),    # per-config RNG keys
+    )
+
+
+def trace_shap_plan_program(plan, *, mesh=None, max_depth=48, mode="path",
+                            n_background=8, grower=None):
+    """ClosedJaxpr of one SHAP plan's whole-family EXPLAIN program — the
+    SAME ``make_shap_plan_fn`` program pipeline.shap_grid dispatches,
+    traced at the plan's padded batch shape with abstract inputs."""
+    from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.parallel import sweep
+
+    _fs_name, model_name = plan.family
+    n, n_feat, n_trees = plan.shape[0], plan.shape[1], plan.shape[2]
+    n_explain = plan.shape[-1]
+    spec = cfg.MODELS[model_name]
+    if spec.n_trees != n_trees:
+        spec = type(spec)(spec.name, n_trees, spec.bootstrap,
+                          spec.random_splits, spec.sqrt_features)
+    fn = sweep.make_shap_plan_fn(
+        spec, mesh, n=n, n_feat=n_feat, max_depth=max_depth,
+        n_explain=n_explain, mode=mode,
+        n_background=(n_background if mode == "interventional" else 0),
+        grower=grower)
+    return trace_entry(fn, abstract_explain_plan_args(plan))

@@ -93,6 +93,12 @@ KNOBS = {
     "F16_PCA_IMPL": ("enum", ("", "svd", "eigh")),
     "F16_SHAP_SBLK": ("int", 1),
     "F16_SHAP_LBLK": ("int", 1),
+    # work-item SHAP engine knobs (ops/treeshap.py, ISSUE 14): path-block
+    # width of the packed unit kernel, and the live-read explain
+    # tree-chunk bound (consulted per call through the resilience
+    # ladder's halving path — not frozen at import).
+    "F16_SHAP_PBLK": ("int", 1),
+    "F16_SHAP_TREE_CHUNK": ("int", 1),
     # grower tier + histogram-grower knobs (ops/trees.py, ISSUE 9)
     "F16_ENSEMBLE_GROWER": ("enum", ("hist", "exact")),
     "F16_HIST_BINS": ("int", 2),

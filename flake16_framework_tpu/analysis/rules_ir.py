@@ -24,7 +24,8 @@ Rules:
   with it on.
 - I301 (error): static dispatch census (#plans for the full grid,
   parallel/planner.py) disagrees with the runtime
-  ``grid_dispatch_count`` the bench gate recorded — the planner's
+  ``grid_dispatch_count`` — or, for the planner's SHAP arm (ISSUE 14),
+  ``shap_dispatch_count`` — the bench recorded: the planner's
   one-program-per-family contract no longer holds.
 - I401 (error): a plan's peak-memory envelope (ir.peak_live_bytes)
   exceeds the device budget (``F16_DEVICE_BUDGET_MB``) — the run would
@@ -98,10 +99,11 @@ def static_plans(*, n=120, n_folds=10, devices=1, tree_overrides=None):
         n_folds=n_folds, tree_overrides=tree_overrides)
 
 
-def latest_bench_census(repo=None):
-    """(runtime grid_dispatch_count, grid_plans, grid_configs, path)
-    from the NEWEST committed BENCH_r*.json that carries the dispatch
-    census (BENCH_r08 onward), or None when no record does."""
+def latest_bench_census(repo=None, metric="grid_dispatch_count"):
+    """(runtime dispatch count, grid_plans, grid_configs, path) for
+    ``metric`` from the NEWEST committed BENCH_r*.json that carries it
+    (grid_dispatch_count from BENCH_r08, shap_dispatch_count from
+    BENCH_r09), or None when no record does."""
     repo = repo or os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     best = None
@@ -118,7 +120,7 @@ def latest_bench_census(repo=None):
                                                  dict) else obj
         detail = (parsed.get("detail") or {}) if isinstance(parsed,
                                                             dict) else {}
-        count = detail.get("grid_dispatch_count")
+        count = detail.get(metric)
         if isinstance(count, (int, float)):
             best = (int(count), detail.get("grid_plans"),
                     detail.get("grid_configs"), os.path.basename(p))
@@ -160,6 +162,44 @@ def census_findings(plans=None, *, repo=None, runtime_count=None):
             f"({source}) measured {int(runtime_count)} dispatches — "
             "the one-program-per-family contract drifted",
             path=_SWEEP_PATH, entry="grid_dispatch_count"))
+    return findings, {"static": static_n, "runtime": int(runtime_count),
+                      "source": source,
+                      "match": int(runtime_count) == static_n}
+
+
+def shap_census_findings(plans=None, *, repo=None, runtime_count=None):
+    """I301 for the SHAP arm (ISSUE 14): the planner groups the explain
+    grid exactly like the fit grid (plan_explain_grid delegates to
+    plan_grid), so the static SHAP census IS #plans; the runtime side is
+    bench's ``shap_dispatch_count`` (the dispatch_stats delta around the
+    warm whole-grid shap_grid pass). Same staleness rule as the fit
+    census: a record measured on a different grid size binds nothing."""
+    from flake16_framework_tpu import config as cfg
+
+    plans = static_plans() if plans is None else plans
+    static_n = len(plans)
+    grid_size = len(list(cfg.iter_config_keys()))
+    source = "caller"
+    if runtime_count is None:
+        rec = latest_bench_census(repo, metric="shap_dispatch_count")
+        if rec is None:
+            return [], {"static": static_n, "runtime": None,
+                        "source": None, "match": None}
+        runtime_count, _plans_rec, rec_grid, source = rec
+        if rec_grid is not None and int(rec_grid) != grid_size:
+            return [], {"static": static_n, "runtime": int(runtime_count),
+                        "source": source, "match": None,
+                        "stale": f"bench measured a {rec_grid}-config "
+                                 f"grid; current grid is {grid_size}"}
+    findings = []
+    if int(runtime_count) != static_n:
+        findings.append(_finding(
+            "I301",
+            f"static SHAP dispatch census is {static_n} plan(s) for the "
+            f"{grid_size}-config grid but the runtime census "
+            f"({source}) measured {int(runtime_count)} dispatches — "
+            "the one-explain-program-per-family contract drifted",
+            path=_SWEEP_PATH, entry="shap_dispatch_count"))
     return findings, {"static": static_n, "runtime": int(runtime_count),
                       "source": source,
                       "match": int(runtime_count) == static_n}
@@ -256,14 +296,15 @@ def crosscheck_findings(entry, closed, *, source_path):
 
 
 def run_audit(*, n=120, n_trees=2, n_folds=10, n_projects=26,
-              max_depth=8, budget_mb=None, repo=None, mesh=True,
-              runtime_count=None):
+              max_depth=8, n_explain=16, budget_mb=None, repo=None,
+              mesh=True, runtime_count=None, runtime_shap_count=None):
     """Trace every real entry point and run every I-rule. Returns
-    (findings, info): ``info`` carries the census reconciliation, the
-    per-plan memory-envelope table (the ``prof_fit --audit`` payload)
-    and the traced-entry list. Shape defaults mirror the bench's
-    dispatch-census stage (n=120, trees=2, max_depth=8) so the static
-    and runtime censuses describe the same programs."""
+    (findings, info): ``info`` carries the census reconciliations (fit
+    AND shap arms), the per-plan memory-envelope table (the ``prof_fit
+    --audit`` payload) and the traced-entry list. Shape defaults mirror
+    the bench's dispatch-census stage (n=120, trees=2, max_depth=8,
+    explain=16) so the static and runtime censuses describe the same
+    programs."""
     from flake16_framework_tpu.analysis import ir
 
     if budget_mb is None:
@@ -275,7 +316,11 @@ def run_audit(*, n=120, n_trees=2, n_folds=10, n_projects=26,
                          tree_overrides=tree_overrides)
     findings, census = census_findings(plans, repo=repo,
                                        runtime_count=runtime_count)
-    info = {"census": census, "envelopes": [], "entries": []}
+    shap_findings, shap_census = shap_census_findings(
+        plans, repo=repo, runtime_count=runtime_shap_count)
+    findings.extend(shap_findings)
+    info = {"census": census, "shap_census": shap_census,
+            "envelopes": [], "entries": []}
 
     def one(entry, closed, *, path, source_path=None, envelope=False,
             batch=None):
@@ -296,6 +341,31 @@ def run_audit(*, n=120, n_trees=2, n_folds=10, n_projects=26,
         closed = ir.trace_plan_program(pl, mesh=None,
                                        n_projects=n_projects,
                                        max_depth=max_depth)
+        one(entry, closed, path=_SWEEP_PATH, envelope=True,
+            batch=pl.batch)
+
+    # The planner's SHAP arm (ISSUE 14): one fused explain program per
+    # family, plus both beyond-paper modes on the first family (the mode
+    # engines are family-independent; one trace each proves the I1/I2
+    # contracts without tripling the audit wall).
+    from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.parallel import planner as _planner
+
+    shap_plans = _planner.plan_explain_grid(
+        list(cfg.iter_config_keys()), devices=1, n=n, n_folds=n_folds,
+        n_explain=n_explain, tree_overrides=tree_overrides)
+    for pl in shap_plans:
+        entry = f"shap.plan_batch[{'/'.join(pl.family)}]"
+        closed = ir.trace_shap_plan_program(pl, mesh=None,
+                                            max_depth=max_depth)
+        one(entry, closed, path=_SWEEP_PATH, envelope=True,
+            batch=pl.batch)
+    for mode in ("interventional", "interaction"):
+        pl = shap_plans[0]
+        entry = f"shap.plan_batch.{mode}[{'/'.join(pl.family)}]"
+        closed = ir.trace_shap_plan_program(pl, mesh=None,
+                                            max_depth=max_depth,
+                                            mode=mode)
         one(entry, closed, path=_SWEEP_PATH, envelope=True,
             batch=pl.batch)
 

@@ -2,16 +2,26 @@
 extension, feature_perturbation='tree_path_dependent', called at
 /root/reference/experiment.py:517; SURVEY.md §2 table B).
 
-Formulation: instead of the reference's sequential recursive EXTEND/UNWIND
-walk, we use the leaf-parallel decomposition (the GPUTreeShap insight — see
-PAPERS.md): each (leaf, sample) pair contributes independently. For a leaf's
-root path, duplicate features merge multiplicatively into per-feature
-(zero_fraction z_f, one_fraction o_f) with at most F unique entries; the
-Shapley permutation weights come from one EXTEND polynomial pass over the F
-feature slots and one UNWIND per present feature — O(F^2) per (leaf, sample),
-F = 16. Leaves and samples ride vmap axes; trees are summed with lax.map so
-only one tree's workspace is live at a time. This maps to the TPU VPU as large
-elementwise/scan batches instead of pointer-chasing recursion.
+Formulation (the GPUTreeShap work-item decomposition, PAPERS.md arxiv
+2010.13972): the forest is flattened into one global work list of
+(instance, root-leaf path) items. A path's duplicate features merge
+multiplicatively into per-unique-feature (zero_fraction z, interval
+(lo, hi] whose membership is the one_fraction o), so each item is a compact
+row of u <= min(F, depth) slots; the Shapley permutation weights come from
+one EXTEND polynomial pass over the slots and one batched UNWIND — O(cap^2)
+per item where cap is the item's bin. The host driver bin-packs items by u
+into power-of-two caps so short paths stop paying the F = 16 worst case,
+and runs each bin as ONE batched unit program — a Pallas TPU kernel on
+device, and a bit-identical XLA program as the fallback ladder rung (both
+compute the same per-(path-block, sample-block) partials via
+``_unit_block_math`` and share one final block sum). A single-bucket
+traceable variant (``_graph_forest_shap``) serves jit contexts: the serve
+AOT executables and the planner's fused shap arm.
+
+Beyond the paper's path-dependent mode, the same compact path form powers
+``forest_shap_interventional`` (feature_perturbation='interventional'
+against a background set, closed-form p!q! weighting) and
+``forest_shap_interactions`` (SHAP interaction values via per-pair UNWIND).
 
 Output convention matches the reference exactly: ``shap_values(X)[0]`` —
 contributions to the *class-0 probability* of the soft-vote ensemble, an
@@ -22,13 +32,14 @@ tests enforce, alongside a brute-force subset-enumeration oracle on tiny trees.
 """
 
 import functools
+import math
 import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from flake16_framework_tpu.obs import costs as _costs
 from flake16_framework_tpu.ops.trees import slice_trees, trim_nodes
@@ -253,6 +264,13 @@ def forest_shap_class0(forest, x, *, sample_chunk=None, impl="auto",
         # envelope-overrun the halved bounds shrink the live workspace and
         # the single-dispatch duration. Top level only — the tree_chunk
         # recursion below passes already-halved bounds with _trim=False.
+        # F16_SHAP_TREE_CHUNK is consulted LIVE (per explain, not once at
+        # import) so a mid-process export — e.g. an operator reacting to a
+        # tunnel fault — takes effect on the next call and still rides the
+        # ladder's halving path below.
+        if tree_chunk is None:
+            env = os.environ.get("F16_SHAP_TREE_CHUNK", "").strip()
+            tree_chunk = int(env) if env else None
         sample_chunk = _ladder.halved(sample_chunk)
         tree_chunk = _ladder.halved(tree_chunk)
         m = forest.feature.shape[-1]
@@ -311,7 +329,10 @@ def forest_shap_class0(forest, x, *, sample_chunk=None, impl="auto",
             impl = "xla"
     if impl != "xla":
         raise ValueError(f"unknown Tree SHAP impl {impl!r}")
-    return _xla_forest_shap(forest, x, depth=depth, sample_chunk=sample_chunk)
+    # Same host-packed driver as the pallas rung, on the bit-identical XLA
+    # unit program — so an auto-mode fallback reproduces impl="xla" exactly.
+    return _packed_forest_shap(forest, x, depth=depth,
+                               sample_chunk=sample_chunk)
 
 
 class _PallasBrokenProxy:
@@ -340,264 +361,388 @@ class _PallasBrokenProxy:
 _PALLAS_AUTO_BROKEN = _PallasBrokenProxy()
 
 
-@functools.partial(jax.jit, static_argnames=("depth", "sample_chunk"))
-def _xla_forest_shap(forest, x, *, depth, sample_chunk=None):
-    n_features = x.shape[1]
-
-    def one_tree(args):
-        fe, th, le, ri, va = args
-        paths = extract_paths(fe, th, le, ri, va, depth)
-        if sample_chunk is None:
-            return tree_shap_single(paths, x, n_features)
-        n = x.shape[0]
-        pads = (-n) % sample_chunk
-        xp = jnp.pad(x, ((0, pads), (0, 0)))
-        chunks = xp.reshape(-1, sample_chunk, n_features)
-        out = lax.map(
-            lambda c: tree_shap_single(paths, c, n_features), chunks
-        )
-        return out.reshape(-1, n_features)[:n]
-
-    phis = lax.map(
-        one_tree,
-        (forest.feature, forest.threshold, forest.left, forest.right,
-         forest.value),
-    )
-    return jnp.mean(phis, axis=0)
-
-
 # --------------------------------------------------------------------------
-# Pallas TPU kernel
+# Work-item engine (GPUTreeShap decomposition)
 # --------------------------------------------------------------------------
-#
-# Layout (north star: "rewrite shap.TreeExplainer's tree-path-dependent value
-# computation as a Pallas kernel"; parallelization over (tree, sample) blocks
-# is the GPUTreeShap decomposition — PAPERS.md):
-#
-#   grid = (sample_block, tree, leaf_block); the output block [F, SBLK]
-#   depends only on the sample block, so the (tree, leaf) dims accumulate
-#   into a resident VMEM block. Samples ride the 128-wide lane axis; the
-#   EXTEND weight vector rides sublanes ([F+2, SBLK] tiles). A leaf's D path
-#   steps are merged into per-feature (zero fraction, one fraction) with
-#   three tiny [F, D] x [D, SBLK] MXU matmuls (one-hot selects instead of
-#   gathers, which TPU lacks along sublanes). Per-tree real-leaf counts are
-#   scalar-prefetched so padded leaf blocks predicate off.
 
-# Env-overridable for the hardware tuning session (read at import, like
-# the tree-grower knobs — tools/hw_probe.py runs each combo in a fresh
-# subprocess). Defaults are the shipped configuration.
+# Finite interval sentinels: +/-inf would turn the kernel's masked one-hot
+# selects into 0*inf = NaN on dead slots; every real f32 input is < 3.4e38.
+_BIG = 3.4e38
+
+# Env-overridable tile shapes for the hardware tuning session (read at
+# import, like the tree-grower knobs — tools/hw_probe.py runs each combo in
+# a fresh subprocess). Samples ride the 128-wide lane axis; paths are
+# blocked _PBLK at a time along sublanes.
 _SBLK = int(os.environ.get("F16_SHAP_SBLK", "128"))
-_LBLK = int(os.environ.get("F16_SHAP_LBLK", "8"))
+_LBLK = int(os.environ.get("F16_SHAP_LBLK", "8"))  # legacy kernel tile knob
+_PBLK = int(os.environ.get("F16_SHAP_PBLK", "8"))
 
 
-def _shap_kernel(n_leaves_ref, sf, sthr, sratio, sleft, svalid, leaf_p0,
-                 leaf_ok, xt, out, *, n_features, depth):
-    sb, t, lb = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    f32 = jnp.float32
-    fp2 = n_features + 2
+def _compact_paths_core(forest, depth, n_features):
+    """Flatten the forest into the global work list: one row per
+    (tree, leaf-slot), each a compact per-unique-feature path description.
 
-    @pl.when((t == 0) & (lb == 0))
-    def _():
-        out[:] = jnp.zeros_like(out)
-
-    block_has_leaves = lb * _LBLK < n_leaves_ref[t]
-
-    @pl.when(block_has_leaves)
-    def _():
-        x_fs = xt[:]                                   # [F, SBLK]
-        iota_f = lax.broadcasted_iota(f32, (n_features, depth), 0)
-        iota_i = lax.broadcasted_iota(f32, (fp2, 1), 0)
-        # One-hot row selects throughout, NEVER dynamic VMEM indexing:
-        # a traced scalar index (a[leaf, :], w[li, :]) is the classic
-        # construct that passes the Pallas interpreter but trips Mosaic
-        # lowering on real silicon; compares + dots lower unconditionally.
-        iota_lb = lax.broadcasted_iota(f32, (1, _LBLK), 1)
-
-        def one_leaf(leaf, acc):
-            onehot_l = (iota_lb == leaf.astype(f32)).astype(f32)  # [1,LBLK]
-
-            def sel_l(ref):
-                """[D] row of one path tensor at ``leaf``: elementwise
-                mask + sublane reduce, NOT a dot — the MXU's default bf16
-                pass would round thresholds/ratios before use (the known
-                TPU matmul-precision trap, trees.py)."""
-                return jnp.sum(ref[0].astype(f32) * onehot_l.T, axis=0)
-
-            sf_l = sel_l(sf)                           # [D] f32 (small ints)
-            svalid_l = sel_l(svalid)
-            onehot_fd = (sf_l[None, :] == iota_f) & (svalid_l[None, :] > 0)
-            onehot_fd = onehot_fd.astype(f32)          # [F, D]
-
-            # Merged per-feature fractions: z (cover products, via logs),
-            # presence, and the per-sample one-fraction o (AND of branch
-            # indicators along the path, via a zero count).
-            # HIGHEST on every data-carrying dot: the one-hot operand is
-            # bf16-exact but the MXU's default pass would round the DATA
-            # side (logs, x values) before accumulating — the same trap
-            # the tree growers pin (trees.py precision=HIGHEST).
-            hi = lax.Precision.HIGHEST
-            logr = jnp.log(jnp.maximum(sel_l(sratio), 1e-30))
-            z = jnp.exp(
-                jnp.dot(onehot_fd, logr[:, None],
-                        preferred_element_type=f32, precision=hi)
-            )                                          # [F, 1]
-            present = (
-                jnp.dot(onehot_fd, jnp.ones((depth, 1), f32),
-                        preferred_element_type=f32, precision=hi) > 0
-            )                                          # [F, 1]
-
-            x_sel = jnp.dot(onehot_fd.T, x_fs,
-                            preferred_element_type=f32,
-                            precision=hi)              # [D, SBLK]
-            goes_left = x_sel <= sel_l(sthr)[:, None]
-            ind = jnp.where(sel_l(sleft)[:, None] > 0, goes_left,
-                            ~goes_left)
-            miss = jnp.dot(onehot_fd, 1.0 - ind.astype(f32),
-                           preferred_element_type=f32, precision=hi)
-            o = (miss == 0).astype(f32)                # [F, SBLK]
-
-            # EXTEND: fold each present feature into the permutation-weight
-            # vector w [F+2, SBLK]; path length l is sample-independent.
-            w0 = jnp.zeros((fp2, _SBLK), f32).at[0, :].set(1.0)
-            iota_fx = lax.broadcasted_iota(f32, (1, n_features), 1)
-
-            def ext(f, carry):
-                w, l = carry
-                onehot_fx = (iota_fx == f.astype(f32)).astype(f32)  # [1,F]
-                # elementwise mask + reduce (no MXU rounding of z/o)
-                pf = jnp.sum(present.astype(f32) * onehot_fx.T) > 0
-                zf = jnp.sum(z * onehot_fx.T)
-                of = jnp.sum(o * onehot_fx.T, axis=0)[None, :]  # [1, SBLK]
-                stay = zf * w * (l - iota_i) / (l + 1.0)
-                w_shift = jnp.concatenate(
-                    [jnp.zeros((1, _SBLK), f32), w[:-1, :]], axis=0
-                )
-                up = of * w_shift * iota_i / (l + 1.0)
-                return (jnp.where(pf, stay + up, w),
-                        jnp.where(pf, l + 1.0, l))
-
-            w, l = lax.fori_loop(0, n_features, ext, (w0, jnp.float32(1.0)))
-
-            # UNWIND all features at once, j from high to low; total is the
-            # sum of unwound weights, phi_f = (o_f - z_f) * total * leaf_p0.
-            onehot_li = (iota_i == (l - 1.0)).astype(f32)   # [F+2, 1]
-            w_l = jnp.sum(w * onehot_li, axis=0)            # [SBLK]
-            nxt0 = jnp.broadcast_to(w_l[None, :], (n_features, _SBLK))
-            zb = jnp.broadcast_to(z, (n_features, _SBLK))
-            zb = jnp.maximum(zb, 1e-30)
-
-            def unwind(jj, carry):
-                total, nxt = carry
-                j = jnp.float32(fp2 - 2) - jj          # static countdown
-                activ = (j <= l - 2.0)
-                onehot_j = (iota_i == j).astype(f32)   # [F+2, 1]
-                wj_row = jnp.sum(w * onehot_j, axis=0)  # [SBLK]
-                wj = jnp.broadcast_to(wj_row[None, :],
-                                      (n_features, _SBLK))
-                o_safe = jnp.where(o == 0, 1.0, o)
-                tmp = nxt * l / ((j + 1.0) * o_safe)
-                total_o = total + tmp
-                nxt_o = wj - tmp * zb * (l - 1.0 - j) / l
-                total_z = total + wj * l / (zb * (l - 1.0 - j))
-                tot_new = jnp.where(o == 0, total_z, total_o)
-                nxt_new = jnp.where(o == 0, nxt, nxt_o)
-                total = jnp.where(activ, tot_new, total)
-                nxt = jnp.where(activ, nxt_new, nxt)
-                return total, nxt
-
-            total, _ = lax.fori_loop(
-                0, fp2 - 1, unwind,
-                (jnp.zeros((n_features, _SBLK), f32), nxt0),
-            )
-
-            scale = (jnp.sum(leaf_p0[0] * onehot_l[0])
-                     * jnp.sum(leaf_ok[0] * onehot_l[0]))
-            contrib = jnp.where(
-                present & (l > 1.0), (o - zb) * total * scale, 0.0
-            )
-            return acc + contrib
-
-        acc = lax.fori_loop(
-            0, _LBLK, one_leaf, jnp.zeros((n_features, _SBLK), f32)
-        )
-        out[:] += acc
-
-
-@functools.partial(jax.jit, static_argnames=("depth", "interpret"))
-def _pallas_forest_shap(forest, x, *, depth, interpret):
-    """[F, S]-accumulating Pallas launch over (sample, tree, leaf) blocks;
-    returns the per-sample mean over trees, transposed to [S, F]."""
-    t, m = forest.feature.shape
-    s, n_features = x.shape
-    # Pad the feature (sublane) axis to the f32 tile minimum; padded feature
-    # rows never match a path step (their one-hot rows stay empty), so their
-    # contributions are exactly zero and are sliced off at the end.
-    n_feat_k = max(8, n_features + (-n_features) % 8)
-
+    Returns dict of [P, F] / [P] arrays, P = T * leaf_slots:
+      fid   int32  feature id per slot, present slots first (argsort order);
+                   slots >= u are dead
+      z     f32    merged zero fraction (product of the feature's cover
+                   ratios along the path)
+      lo,hi f32    merged branch constraints as one interval:
+                   one_fraction o = (x > lo) & (x <= hi)
+      u     int32  unique-feature count — live slots are exactly [0, u)
+      scale f32    leaf_p0 for real leaves (the per-item output weight;
+                   callers divide the summed phi by T — dividing at the
+                   end instead of per item saves one rounding per term)
+      valid bool   real leaf (leaf_ok & u > 0 rows are worth running)
+    """
     paths = jax.vmap(
         lambda fe, th, le, ri, va: extract_paths(fe, th, le, ri, va, depth)
     )(forest.feature, forest.threshold, forest.left, forest.right,
       forest.value)
 
-    l_slots = paths["sf"].shape[1]
-    l_pad = (-l_slots) % _LBLK
-    s_pad = (-s) % _SBLK
+    sf, sthr, sratio, sleft, svalid = (
+        paths["sf"], paths["sthr"], paths["sratio"], paths["sleft"],
+        paths["svalid"])
+    onehot = (sf[..., None] == jnp.arange(n_features)[None, None, None, :]
+              ) & svalid[..., None]                       # [T, L, D, F]
+    present = onehot.any(axis=2)                          # [T, L, F]
+    z = jnp.prod(jnp.where(onehot, sratio[..., None], 1.0), axis=2)
+    # Left steps bound from above (x <= thr), right steps from below
+    # (x > thr); conjunction over duplicates collapses to one interval.
+    left_oh = onehot & sleft[..., None]
+    right_oh = onehot & ~sleft[..., None]
+    hi = jnp.min(jnp.where(left_oh, sthr[..., None], _BIG), axis=2)
+    lo = jnp.max(jnp.where(right_oh, sthr[..., None], -_BIG), axis=2)
 
-    def pad_l(a, fill=0):
-        return jnp.pad(a, ((0, 0), (0, l_pad)) + ((0, 0),) * (a.ndim - 2),
-                       constant_values=fill)
+    u = present.sum(axis=-1).astype(jnp.int32)            # [T, L]
+    order = jnp.argsort(~present, axis=-1, stable=True)   # present first
+    gather = lambda a: jnp.take_along_axis(a, order, axis=-1)
+    scale = jnp.where(paths["leaf_ok"], paths["leaf_p0"], 0.0)
 
-    sf = pad_l(paths["sf"]).astype(jnp.int32)
-    sthr = pad_l(paths["sthr"]).astype(jnp.float32)
-    sratio = pad_l(paths["sratio"], 1).astype(jnp.float32)
-    sleft = pad_l(paths["sleft"]).astype(jnp.int32)
-    svalid = pad_l(paths["svalid"]).astype(jnp.int32)
-    leaf_p0 = pad_l(paths["leaf_p0"]).astype(jnp.float32)
-    leaf_ok = pad_l(paths["leaf_ok"]).astype(jnp.float32)
-    n_leaves = jnp.sum(paths["leaf_ok"], axis=1).astype(jnp.int32)  # [T]
+    flat = lambda a: a.reshape((-1,) + a.shape[2:])
+    return {
+        "fid": flat(order).astype(jnp.int32), "z": flat(gather(z)),
+        "lo": flat(gather(lo)), "hi": flat(gather(hi)), "u": flat(u),
+        "scale": flat(scale), "valid": flat(paths["leaf_ok"] & (u > 0)),
+    }
 
+
+@functools.partial(jax.jit, static_argnames=("depth", "n_features"))
+def _compact_paths(forest, *, depth, n_features):
+    return _compact_paths_core(forest, depth, n_features)
+
+
+def _unit_block_math(fidb, zb, lob, hib, ub, scaleb, xt):
+    """Partial phi for one (path-block, sample-block): [n_feat_k, sblk].
+
+    fidb/zb/lob/hib: [pblk, cap] f32; ub/scaleb: [pblk] f32;
+    xt: [n_feat_k, sblk] f32 (features x samples, both padded).
+
+    Pure jnp on VALUES (no refs, no dynamic indexing — one-hot row selects
+    throughout, the Mosaic-safe idiom), called verbatim from BOTH the
+    Pallas kernel body and the XLA unit program so the two ladder rungs
+    stay bit-identical: every select/scatter dot has at most one nonzero
+    term per output cell (exact in f32 at HIGHEST precision) and the
+    EXTEND/UNWIND arithmetic is the same expression graph, so equality
+    holds to the last ulp, not just to tolerance.
+    """
+    f32 = jnp.float32
+    hi_prec = lax.Precision.HIGHEST
+    pblk, cap = fidb.shape
+    n_feat_k, sblk = xt.shape
+    c2 = cap + 2
+    iota_p = lax.broadcasted_iota(f32, (1, pblk), 1)
+    iota_c = lax.broadcasted_iota(f32, (cap, 1), 0)
+    iota_f = lax.broadcasted_iota(f32, (cap, n_feat_k), 1)
+    iota_i = lax.broadcasted_iota(f32, (c2, 1), 0)
+
+    def one_path(p, acc):
+        onehot_p = (iota_p == p.astype(f32)).astype(f32)   # [1, pblk]
+
+        def sel(a):  # [pblk, cap] -> [cap, 1] row at p (masked sum, exact)
+            return jnp.sum(a * onehot_p.T, axis=0)[:, None]
+
+        fid_p, z_p = sel(fidb), sel(zb)
+        lo_p, hi_p = sel(lob), sel(hib)
+        u_p = jnp.sum(ub * onehot_p[0])
+        sc_p = jnp.sum(scaleb * onehot_p[0])
+        live = iota_c < u_p                                # [cap, 1]
+
+        onehot_kf = ((fid_p == iota_f) & live).astype(f32)  # [cap, n_feat_k]
+        x_sel = jnp.dot(onehot_kf, xt, preferred_element_type=f32,
+                        precision=hi_prec)                 # [cap, sblk]
+        o = ((x_sel > lo_p) & (x_sel <= hi_p)).astype(f32)
+
+        # EXTEND over the cap slots (live slots are the prefix [0, u)).
+        w0 = jnp.zeros((c2, sblk), f32).at[0, :].set(1.0)
+
+        def ext(k, carry):
+            w, l = carry
+            onehot_k = (iota_c == k.astype(f32)).astype(f32)  # [cap, 1]
+            pf = k.astype(f32) < u_p
+            zf = jnp.sum(z_p * onehot_k)
+            of = jnp.sum(o * onehot_k, axis=0)[None, :]       # [1, sblk]
+            stay = zf * w * (l - iota_i) / (l + 1.0)
+            w_shift = jnp.concatenate(
+                [jnp.zeros((1, sblk), f32), w[:-1, :]], axis=0)
+            up = of * w_shift * iota_i / (l + 1.0)
+            return (jnp.where(pf, stay + up, w),
+                    jnp.where(pf, l + 1.0, l))
+
+        w, l = lax.fori_loop(0, cap, ext, (w0, jnp.float32(1.0)))
+
+        # UNWIND every slot at once, positions high to low; total is the
+        # sum of unwound weights, contrib_k = (o_k - z_k) * total * scale.
+        onehot_li = (iota_i == (l - 1.0)).astype(f32)      # [c2, 1]
+        w_l = jnp.sum(w * onehot_li, axis=0)               # [sblk]
+        nxt0 = jnp.broadcast_to(w_l[None, :], (cap, sblk))
+        z_sf = jnp.maximum(jnp.broadcast_to(z_p, (cap, sblk)), 1e-30)
+
+        def unwind(jj, carry):
+            total, nxt = carry
+            j = jnp.float32(c2 - 2) - jj.astype(f32)
+            activ = j <= l - 2.0
+            onehot_j = (iota_i == j).astype(f32)           # [c2, 1]
+            wj = jnp.broadcast_to(
+                jnp.sum(w * onehot_j, axis=0)[None, :], (cap, sblk))
+            o_safe = jnp.where(o == 0, 1.0, o)
+            tmp = nxt * l / ((j + 1.0) * o_safe)
+            total_o = total + tmp
+            nxt_o = wj - tmp * z_sf * (l - 1.0 - j) / l
+            total_z = total + wj * l / (z_sf * (l - 1.0 - j))
+            tot_new = jnp.where(o == 0, total_z, total_o)
+            nxt_new = jnp.where(o == 0, nxt, nxt_o)
+            return (jnp.where(activ, tot_new, total),
+                    jnp.where(activ, nxt_new, nxt))
+
+        total, _ = lax.fori_loop(
+            0, c2 - 1, unwind, (jnp.zeros((cap, sblk), f32), nxt0))
+
+        contrib = jnp.where(live & (l > 1.0),
+                            (o - z_p) * total * sc_p, 0.0)  # [cap, sblk]
+        # Scatter slots -> features; each (f, s) cell has at most one
+        # nonzero term (fids are unique on a path), so the dot is exact
+        # and order-independent.
+        return acc + jnp.dot(onehot_kf.T, contrib,
+                             preferred_element_type=f32, precision=hi_prec)
+
+    return lax.fori_loop(0, pblk, one_path,
+                         jnp.zeros((n_feat_k, sblk), f32))
+
+
+def _unit_kernel(fid_ref, z_ref, lo_ref, hi_ref, u_ref, scale_ref, xt_ref,
+                 out_ref):
+    out_ref[0] = _unit_block_math(
+        fid_ref[:], z_ref[:], lo_ref[:], hi_ref[:],
+        u_ref[0], scale_ref[0], xt_ref[:])
+
+
+def _unit_partials(fid, z, lo, hi, u, scale, x, *, use_pallas,
+                   interpret=False):
+    """Per-(path-block) partial phis [n_pb, n_feat_k, s_tot], traceable.
+
+    No cross-block accumulation happens here — the caller owns the single
+    final block sum, so the pallas and XLA variants (which emit identical
+    partials) reduce in the same order and agree bitwise.
+    """
+    r, cap = fid.shape
+    s, n_features = x.shape
+    n_feat_k = max(8, n_features + (-n_features) % 8)
+    s_tot = s + (-s) % _SBLK
     xt = jnp.pad(x.T.astype(jnp.float32),
-                 ((0, n_feat_k - n_features), (0, s_pad)))
+                 ((0, n_feat_k - n_features), (0, s_tot - s)))
+    n_pb = r // _PBLK
+    f32 = jnp.float32
+    fid_f = fid.astype(f32)
+    z_f, lo_f, hi_f = z.astype(f32), lo.astype(f32), hi.astype(f32)
+    u_f = u.astype(f32).reshape(n_pb, _PBLK)
+    sc_f = scale.astype(f32).reshape(n_pb, _PBLK)
+    if use_pallas:
+        row_spec = pl.BlockSpec((_PBLK, cap), lambda pb, sb: (pb, 0))
+        meta_spec = pl.BlockSpec((1, _PBLK), lambda pb, sb: (pb, 0))
+        return pl.pallas_call(
+            _unit_kernel,
+            grid=(n_pb, s_tot // _SBLK),
+            in_specs=[row_spec, row_spec, row_spec, row_spec,
+                      meta_spec, meta_spec,
+                      pl.BlockSpec((n_feat_k, _SBLK),
+                                   lambda pb, sb: (0, sb))],
+            out_specs=pl.BlockSpec((1, n_feat_k, _SBLK),
+                                   lambda pb, sb: (pb, 0, sb)),
+            out_shape=jax.ShapeDtypeStruct((n_pb, n_feat_k, s_tot), f32),
+            interpret=interpret,
+        )(fid_f, z_f, lo_f, hi_f, u_f, sc_f, xt)
 
-    lt = (l_slots + l_pad) // _LBLK
-    st = (s + s_pad) // _SBLK
+    blk = lambda a: a.reshape(n_pb, _PBLK, cap)
+    xtb = xt.reshape(n_feat_k, s_tot // _SBLK, _SBLK)
 
-    # Index maps receive the scalar-prefetch ref as a trailing argument.
-    path_spec = pl.BlockSpec(
-        (1, _LBLK, depth), lambda sb, t_, lb, nl: (t_, lb, 0)
-    )
-    leaf_spec = pl.BlockSpec((1, _LBLK), lambda sb, t_, lb, nl: (t_, lb))
+    def one_block(fb, zb, lb, hb, ub, sb):
+        per_tile = jax.vmap(
+            lambda xt_blk: _unit_block_math(fb, zb, lb, hb, ub, sb, xt_blk),
+            in_axes=1, out_axes=1)(xtb)    # [n_feat_k, st, _SBLK]
+        return per_tile.reshape(n_feat_k, s_tot)
 
-    out = pl.pallas_call(
-        functools.partial(_shap_kernel, n_features=n_feat_k, depth=depth),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(st, t, lt),
-            in_specs=[
-                path_spec, path_spec, path_spec, path_spec, path_spec,
-                leaf_spec, leaf_spec,
-                pl.BlockSpec((n_feat_k, _SBLK),
-                             lambda sb, t_, lb, nl: (0, sb)),
-            ],
-            out_specs=pl.BlockSpec((n_feat_k, _SBLK),
-                                   lambda sb, t_, lb, nl: (0, sb)),
-        ),
-        out_shape=jax.ShapeDtypeStruct((n_feat_k, s + s_pad), jnp.float32),
-        interpret=interpret,
-    )(n_leaves, sf, sthr, sratio, sleft, svalid, leaf_p0, leaf_ok, xt)
-
-    return out[:n_features, :s].T / t
+    return jax.vmap(one_block)(blk(fid_f), blk(z_f), blk(lo_f), blk(hi_f),
+                               u_f, sc_f)
 
 
-# Cost attribution (obs/costs.py): the two explain programs are the SHAP
-# stage's compiled kernels; the driver (forest_shap_class0) dispatches them
-# from host, so the wrapper sees concrete arrays and can AOT-compile.
+@jax.jit
+def _unit_shap_xla(fid, z, lo, hi, u, scale, x):
+    return _unit_partials(fid, z, lo, hi, u, scale, x, use_pallas=False)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _unit_shap_pallas(fid, z, lo, hi, u, scale, x, *, interpret=False):
+    return _unit_partials(fid, z, lo, hi, u, scale, x, use_pallas=True,
+                          interpret=interpret)
+
+
+def _pack_work_items(comp, *, n_features, depth):
+    """Host-side bin packing: work items -> [(cap, row_ids), ...].
+
+    Each kept row (real leaf, u > 0) lands in the bucket whose cap is the
+    next power of two >= its unique-feature count u, clamped to
+    min(F, depth) — so the number of occupied buckets (and hence unit
+    dispatches) is <= log2(F) + 1 = O(1).
+    """
+    u = np.asarray(comp["u"])
+    keep = np.asarray(comp["valid"]) & (u > 0)
+    cap_max = int(min(n_features, depth))
+    caps = np.minimum(
+        np.power(2, np.ceil(np.log2(np.maximum(u, 1)))).astype(np.int64),
+        cap_max)
+    return [(int(cap), np.nonzero(keep & (caps == cap))[0])
+            for cap in sorted(set(caps[keep].tolist()))]
+
+
+def packing_histogram(forest, n_features, *, depth=None):
+    """Bin-packing census for one forest: {cap: {paths, mean_u,
+    slot_util}} — the PROFILE.md packing histogram and the knob-tuning
+    signal (slot_util near 1.0 means the caps fit the path population)."""
+    depth = int(forest.max_depth) if depth is None else depth
+    comp = jax.device_get(
+        _compact_paths(forest, depth=depth, n_features=n_features))
+    u = np.asarray(comp["u"])
+    return {
+        cap: {
+            "paths": int(rows.size),
+            "mean_u": float(u[rows].mean()),
+            "slot_util": float(u[rows].mean() / cap),
+        }
+        for cap, rows in _pack_work_items(comp, n_features=n_features,
+                                          depth=depth)
+    }
+
+
+def _packed_forest_shap(forest, x, *, depth, use_pallas=False,
+                        interpret=False, sample_chunk=None):
+    """Host-packed explain: compact the forest once, bin-pack the work
+    list, run one unit program per occupied cap bucket, and sum the
+    per-block partials. Identical values (bitwise) for the XLA and pallas
+    units — see _unit_block_math."""
+    s, n_features = x.shape
+    if sample_chunk is not None and sample_chunk < s:
+        outs = [
+            _packed_forest_shap(forest, x[lo_:lo_ + sample_chunk],
+                                depth=depth, use_pallas=use_pallas,
+                                interpret=interpret)
+            for lo_ in range(0, s, sample_chunk)]
+        return jnp.concatenate(outs, axis=0)
+    comp = jax.device_get(
+        _compact_paths(forest, depth=depth, n_features=n_features))
+    plan = _pack_work_items(comp, n_features=n_features, depth=depth)
+    phi = jnp.zeros((s, n_features), jnp.float32)
+    unit = (functools.partial(_unit_shap_pallas, interpret=interpret)
+            if use_pallas else _unit_shap_xla)
+    for cap, rows in plan:
+        # Rows pad to the next power of two (>= _PBLK) so repeated explains
+        # of similarly-sized forests reuse one compiled unit per (cap, pow2)
+        # instead of recompiling per exact row count.
+        r_pad = max(_PBLK, 1 << max(0, int(rows.size) - 1).bit_length())
+        r_pad += (-r_pad) % _PBLK
+
+        def take(name):
+            a = comp[name][rows]
+            a = a[:, :cap] if a.ndim == 2 else a
+            return np.pad(a, [(0, r_pad - rows.size)] + [(0, 0)] *
+                          (a.ndim - 1))
+
+        parts = unit(take("fid"), take("z"), take("lo"), take("hi"),
+                     take("u"), take("scale"), x)
+        phi = phi + jnp.sum(parts, axis=0)[:n_features, :s].T
+    return phi / forest.feature.shape[0]
+
+
+def _graph_forest_shap(forest, x, *, depth, use_pallas=False,
+                       interpret=False):
+    """Traceable single-bucket engine (cap = min(F, depth)): keeps every
+    (tree, leaf-slot) row masked instead of host-packed, so the whole
+    explain stays inside one jitted program — what the serve AOT
+    executables and the planner's fused shap arm compile."""
+    s, n_features = x.shape
+    comp = _compact_paths_core(forest, depth, n_features)
+    cap = int(min(n_features, depth))
+    p = comp["fid"].shape[0]
+    r_pad = -(-p // _PBLK) * _PBLK
+
+    def pad(a):
+        return jnp.pad(a, [(0, r_pad - p)] + [(0, 0)] * (a.ndim - 1))
+
+    scale = jnp.where(comp["valid"], comp["scale"], 0.0)
+    u = jnp.where(comp["valid"], comp["u"], 0)
+    parts = _unit_partials(
+        pad(comp["fid"][:, :cap]), pad(comp["z"][:, :cap]),
+        pad(comp["lo"][:, :cap]), pad(comp["hi"][:, :cap]),
+        pad(u), pad(scale), x, use_pallas=use_pallas, interpret=interpret)
+    return jnp.sum(parts, axis=0)[:n_features, :s].T / forest.feature.shape[0]
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "sample_chunk"))
+def _xla_forest_shap(forest, x, *, depth, sample_chunk=None):
+    """In-graph explain program (the serve "shap_xla" executable and the
+    audit's traced SHAP entry). ``sample_chunk`` bounds the live
+    workspace inside the one dispatch via lax.map over sample tiles."""
+    n_features = x.shape[1]
+    if sample_chunk is None:
+        return _graph_forest_shap(forest, x, depth=depth)
+    n = x.shape[0]
+    pads = (-n) % sample_chunk
+    xp = jnp.pad(x, ((0, pads), (0, 0)))
+    chunks = xp.reshape(-1, sample_chunk, n_features)
+    out = lax.map(
+        lambda c: _graph_forest_shap(forest, c, depth=depth), chunks)
+    return out.reshape(-1, n_features)[:n]
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "interpret"))
+def _pallas_graph_shap(forest, x, *, depth, interpret=False):
+    """In-graph explain on the Pallas unit kernel — the serve
+    "shap_pallas" executable (single bucket; TPU only off-interpret)."""
+    return _graph_forest_shap(forest, x, depth=depth, use_pallas=True,
+                              interpret=interpret)
+
+
+def _pallas_forest_shap(forest, x, *, depth, interpret):
+    """Host-packed explain on the Pallas unit kernel — the auto/TPU rung
+    of forest_shap_class0 (one unit dispatch per occupied cap bucket)."""
+    return _packed_forest_shap(forest, x, depth=depth, use_pallas=True,
+                               interpret=interpret)
+
+
+# Cost attribution (obs/costs.py): the compiled explain programs. The
+# packed driver dispatches units from host (concrete arrays, AOT-able);
+# the graph programs are what serve and the fused plan arm compile.
 _xla_forest_shap = _costs.instrument(
     _xla_forest_shap, "shap.xla_forest",
     static_argnames=("depth", "sample_chunk"))
-_pallas_forest_shap = _costs.instrument(
-    _pallas_forest_shap, "shap.pallas_forest",
+_pallas_graph_shap = _costs.instrument(
+    _pallas_graph_shap, "shap.pallas_graph",
     static_argnames=("depth", "interpret"))
+_unit_shap_xla = _costs.instrument(_unit_shap_xla, "shap.unit_xla")
+_unit_shap_pallas = _costs.instrument(
+    _unit_shap_pallas, "shap.unit_pallas", static_argnames=("interpret",))
+_compact_paths = _costs.instrument(
+    _compact_paths, "shap.compact", static_argnames=("depth", "n_features"))
 
 
 def expected_p0(forest):
@@ -617,3 +762,225 @@ def expected_p0(forest):
          forest.value),
     )
     return jnp.mean(vals)
+
+
+# --------------------------------------------------------------------------
+# Interventional SHAP (feature_perturbation='interventional')
+# --------------------------------------------------------------------------
+#
+# Closed form over the compact path rows: for a (leaf, x, b) triple the
+# path's unique features partition into both-satisfied (irrelevant — the
+# Shapley sum telescopes them away), neither-satisfied (leaf unreachable
+# for every coalition -> 0), x-only (count p) and b-only (count q); then
+#   phi_i += leaf_w * (p-1)! q! / (p+q)!   for i in x-only
+#   phi_i -= leaf_w * p! (q-1)! / (p+q)!   for i in b-only
+# averaged over the background rows. Everything reduces to three
+# (slots x slots) contractions per row chunk — pure matmuls.
+
+
+def _interventional_tables(n_features):
+    """f64-exact (p, q) weight tables, built at trace time (static F)."""
+    f = [math.factorial(i) for i in range(n_features + 1)]
+    wx = np.zeros((n_features + 1, n_features + 1))
+    wb = np.zeros((n_features + 1, n_features + 1))
+    for pp in range(n_features + 1):
+        for qq in range(n_features + 1 - pp):
+            if pp >= 1:
+                wx[pp, qq] = f[pp - 1] * f[qq] / f[pp + qq]
+            if qq >= 1:
+                wb[pp, qq] = f[pp] * f[qq - 1] / f[pp + qq]
+    return jnp.asarray(wx, jnp.float32), jnp.asarray(wb, jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "row_chunk"))
+def _interventional_jit(forest, x, background, *, depth, row_chunk):
+    s, n_features = x.shape
+    b = background.shape[0]
+    f32 = jnp.float32
+    comp = _compact_paths_core(forest, depth, n_features)
+    scale = jnp.where(comp["valid"], comp["scale"], 0.0)
+    p_rows = comp["fid"].shape[0]
+    rc = p_rows if row_chunk is None else min(row_chunk, p_rows)
+    r_pad = (-p_rows) % rc
+
+    def pad(a):
+        return jnp.pad(a, [(0, r_pad)] + [(0, 0)] * (a.ndim - 1))
+
+    def chunks(a):
+        return pad(a).reshape((-1, rc) + a.shape[1:])
+
+    wx_t, wb_t = _interventional_tables(n_features)
+    k = n_features  # slot count (compact rows keep all F slots here)
+
+    def one_chunk(args):
+        fidc, loc, hic, uc, scc = args
+        live = (jnp.arange(k)[None, :] < uc[:, None]).astype(f32)  # [R, K]
+
+        def sat(pts):  # [N, F] -> [R, N, K] interval membership, live only
+            g = jnp.take(pts, fidc, axis=1)        # [N, R, K]
+            o = (g > loc[None]) & (g <= hic[None])
+            return jnp.moveaxis(o, 0, 1).astype(f32) * live[:, None, :]
+
+        ox = sat(x)                                # [R, S, K]
+        ob = sat(background)                       # [R, B, K]
+        nx = live[:, None, :] - ox                 # live but x-unsatisfied
+        nb = live[:, None, :] - ob
+        pcnt = jnp.einsum("rsk,rbk->rsb", ox, nb)  # x-only counts
+        qcnt = jnp.einsum("rsk,rbk->rsb", nx, ob)  # b-only counts
+        ncnt = jnp.einsum("rsk,rbk->rsb", nx, nb)  # neither -> unreachable
+        ok = (ncnt < 0.5).astype(f32) * scc[:, None, None]
+        idx = (pcnt.astype(jnp.int32) * (n_features + 1)
+               + qcnt.astype(jnp.int32))
+        a_w = jnp.take(wx_t.reshape(-1), idx) * ok   # [R, S, B]
+        b_w = jnp.take(wb_t.reshape(-1), idx) * ok
+        tx = jnp.einsum("rbk,rsb->rsk", nb, a_w)
+        tb = jnp.einsum("rbk,rsb->rsk", ob, b_w)
+        phi_slots = ox * tx - nx * tb               # [R, S, K]
+        onehot = ((fidc[..., None] == jnp.arange(n_features))
+                  & (live[..., None] > 0)).astype(f32)  # [R, K, F]
+        return jnp.einsum("rsk,rkf->sf", phi_slots, onehot)
+
+    per = lax.map(one_chunk, (chunks(comp["fid"]), chunks(comp["lo"]),
+                              chunks(comp["hi"]), chunks(comp["u"]),
+                              chunks(scale)))
+    return jnp.sum(per, axis=0) / (b * forest.feature.shape[0])
+
+
+def forest_shap_interventional(forest, x, background, *, row_chunk=64):
+    """Interventional SHAP of the class-0 soft-vote probability vs a
+    background set: phi [S, F] with sum_f phi[s] = p0(x_s) - mean_b p0(b).
+    ``row_chunk`` bounds the [rows, S, B] workspace per lax.map step."""
+    return _interventional_jit(forest, x, background,
+                               depth=int(forest.max_depth),
+                               row_chunk=row_chunk)
+
+
+# --------------------------------------------------------------------------
+# SHAP interaction values
+# --------------------------------------------------------------------------
+
+
+def _unwind_weights(w, l, z, o):
+    """Full UNWIND: the permutation-weight vector with one feature
+    (fractions z, o) removed — positions [0, l-2) valid, i.e. a path of
+    length l-1. w: [..., F2]; l, z, o broadcastable to w[..., 0].
+    ``_unwound_sum(w, l, z, o)`` equals ``_unwind_weights(...)`` summed
+    over its valid positions; the full vector is what the interaction
+    recurrence needs (a second UNWIND runs on it for the partner
+    feature)."""
+    f2 = w.shape[-1]
+    iota = jnp.arange(f2)
+    li = (l - 1.0).astype(jnp.int32)[..., None]
+    n0 = jnp.take_along_axis(w, jnp.clip(li, 0, f2 - 1), axis=-1)[..., 0]
+    m0 = jnp.zeros_like(w)
+
+    def step(carry, j):
+        n, m = carry
+        lm1 = l - 1.0
+        active = (j <= lm1 - 1.0) & (lm1 > 0)
+        wj = jnp.take(w, j.astype(jnp.int32), axis=-1)
+        o_safe = jnp.where(o == 0, 1.0, o)
+        mj_o = n * l / ((j + 1.0) * o_safe)
+        n_new = wj - mj_o * z * (lm1 - j) / l
+        mj_z = wj * l / (jnp.maximum(z, 1e-30) * (lm1 - j))
+        mj = jnp.where(o == 0, mj_z, mj_o)
+        onehot_j = jnp.arange(f2) == j.astype(jnp.int32)
+        m = jnp.where(active[..., None] & onehot_j, mj[..., None], m)
+        n = jnp.where(active & (o != 0), n_new, n)
+        return (n, m), None
+
+    js = jnp.arange(f2 - 2, -1, -1).astype(w.dtype)
+    (_, m), _ = lax.scan(step, (n0, m0), js)
+    return m
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "row_chunk"))
+def _interactions_jit(forest, x, *, depth, row_chunk):
+    s, n_features = x.shape
+    f32 = jnp.float32
+    comp = _compact_paths_core(forest, depth, n_features)
+    scale = jnp.where(comp["valid"], comp["scale"], 0.0)
+    cap = int(min(n_features, depth))
+    p_rows = comp["fid"].shape[0]
+    rc = p_rows if row_chunk is None else min(row_chunk, p_rows)
+    r_pad = (-p_rows) % rc
+
+    def chunks(a):
+        a = a[:, :cap] if a.ndim == 2 else a
+        a = jnp.pad(a, [(0, r_pad)] + [(0, 0)] * (a.ndim - 1))
+        return a.reshape((-1, rc) + a.shape[1:])
+
+    def one_chunk(args):
+        fidc, zc, loc, hic, uc, scc = args          # [R, K] / [R]
+        live = jnp.arange(cap)[None, :] < uc[:, None]
+        g = jnp.take(x, fidc, axis=1)               # [S, R, K]
+        o = jnp.moveaxis(
+            ((g > loc[None]) & (g <= hic[None])), 0, 1
+        ).astype(f32) * live[:, None, :].astype(f32)  # [R, S, K]
+        pres = jnp.broadcast_to(live[:, None, :], o.shape)
+        zb = jnp.broadcast_to(zc[:, None, :], o.shape)
+        w, l = _extend_all(pres, zb, o, cap)        # [R, S, K+2], [R, S]
+
+        def slot(a, i):
+            return jnp.take(a, i, axis=-1)          # [R, S]
+
+        totals = jax.vmap(
+            lambda i: _unwound_sum(w, l, slot(zb, i), slot(o, i))
+        )(jnp.arange(cap))                          # [K, R, S]
+        phi_slots = jnp.where(
+            live[:, None, :],
+            (o - zb) * jnp.moveaxis(totals, 0, -1) * scc[:, None, None],
+            0.0)                                    # [R, S, K]
+        onehot = ((fidc[..., None] == jnp.arange(n_features))
+                  & live[..., None]).astype(f32)    # [R, K, F]
+        phi_sf = jnp.einsum("rsk,rkf->sf", phi_slots, onehot)
+
+        mj = jax.vmap(
+            lambda j: _unwind_weights(w, l, slot(zb, j), slot(o, j))
+        )(jnp.arange(cap))                          # [K, R, S, K+2]
+
+        def pair(j, i):
+            # phi_ij contribution of this path: condition feature j
+            # present vs absent, then the usual unwound sum for i on the
+            # j-removed weight vector (length l-1).
+            tot = _unwound_sum(jnp.take(mj, j, axis=0), l - 1.0,
+                               slot(zb, i), slot(o, i))  # [R, S]
+            val = (0.5 * (slot(o, j) - slot(zb, j))
+                   * (slot(o, i) - slot(zb, i)) * tot * scc[:, None])
+            mask = (jnp.take(live, j, axis=-1)
+                    & jnp.take(live, i, axis=-1))[:, None] & (i != j)
+            return jnp.where(mask, val, 0.0)
+
+        pv = jax.vmap(lambda j: jax.vmap(lambda i: pair(j, i))(
+            jnp.arange(cap)))(jnp.arange(cap))      # [K, K, R, S]
+        off = jnp.einsum("jirs,rjf,rig->sfg", pv, onehot, onehot)
+        return phi_sf, off
+
+    per_phi, per_off = lax.map(
+        one_chunk,
+        (chunks(comp["fid"]), chunks(comp["z"]), chunks(comp["lo"]),
+         chunks(comp["hi"]), chunks(comp["u"]), chunks(scale)))
+    t = forest.feature.shape[0]
+    phi = jnp.sum(per_phi, axis=0) / t              # [S, F]
+    off = jnp.sum(per_off, axis=0) / t              # [S, F, F]
+    off = 0.5 * (off + jnp.swapaxes(off, 1, 2))     # symmetry exact
+    # Diagonal completes each row to the path-dependent phi, so row sums
+    # (and hence the full-matrix sum) keep local accuracy by construction.
+    diag = phi - jnp.sum(off, axis=2)
+    return off + diag[..., None] * jnp.eye(n_features, dtype=off.dtype)
+
+
+def forest_shap_interactions(forest, x, *, row_chunk=32):
+    """SHAP interaction values of the class-0 soft-vote probability:
+    [S, F, F] with phi_ij == phi_ji and row sums equal to the
+    path-dependent phi (so the matrix sums to p0(x) - E[p0])."""
+    return _interactions_jit(forest, x, depth=int(forest.max_depth),
+                             row_chunk=row_chunk)
+
+
+_interventional_jit = _costs.instrument(
+    _interventional_jit, "shap.interventional",
+    static_argnames=("depth", "row_chunk"))
+_interactions_jit = _costs.instrument(
+    _interactions_jit, "shap.interactions",
+    static_argnames=("depth", "row_chunk"))

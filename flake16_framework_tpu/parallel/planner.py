@@ -132,6 +132,29 @@ def plan_grid(configs, *, devices=1, n, n_folds, tree_overrides=None):
     return plans
 
 
+def explain_shape(fs_name, model_name, *, n, n_folds, n_explain,
+                  tree_overrides=None):
+    """The shape signature one family's fused EXPLAIN program is compiled
+    for: the fit signature plus the explain-set width (the shap arm fits
+    on the full training set, then explains the first n_explain rows)."""
+    return plan_shape(fs_name, model_name, n=n, n_folds=n_folds,
+                      tree_overrides=tree_overrides) + (int(n_explain),)
+
+
+def plan_explain_grid(configs, *, devices=1, n, n_folds, n_explain,
+                      tree_overrides=None):
+    """plan_grid for the whole-grid SHAP pass: identical grouping and
+    determinism contract, shapes extended with ``n_explain`` so the
+    explain batch width is part of each plan's compile signature. The
+    dispatch ledger follows: #plans = #families, so whole-grid SHAP runs
+    in <= #families + O(1) device dispatches."""
+    plans = plan_grid(configs, devices=devices, n=n, n_folds=n_folds,
+                      tree_overrides=tree_overrides)
+    return [Plan(p.family, p.configs, p.indices,
+                 p.shape + (int(n_explain),), pad_to=devices)
+            for p in plans]
+
+
 def plan_table(plans):
     """Rows for the pre-run padding report (tools/prof_fit.py): family,
     member count, padded batch/shape, pad waste."""

@@ -46,7 +46,7 @@ from flake16_framework_tpu.obs import costs
 from flake16_framework_tpu.ops.metrics import confusion_by_project, format_scores
 from flake16_framework_tpu.ops.preprocess import fit_preprocess, transform
 from flake16_framework_tpu.ops.resample import resample
-from flake16_framework_tpu.ops import trees
+from flake16_framework_tpu.ops import trees, treeshap
 from flake16_framework_tpu.parallel import planner
 from flake16_framework_tpu.parallel.folds import fold_masks, lopo_fold_masks
 from flake16_framework_tpu.resilience import (
@@ -569,6 +569,86 @@ def make_plan_fn(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
                       (P(), P(), pspec, pspec, pspec, pspec, pspec, pspec,
                        P()),
                       pspec, "scores.plan_batch", cost_fields=fit_fields)
+
+
+def make_shap_plan_fn(spec, mesh, *, n, n_feat, max_depth=48, n_explain,
+                      mode="path", n_background=0, grower=None,
+                      row_chunk=32):
+    """The planner's SHAP arm — ONE whole-plan EXPLAIN program per family:
+    the paper's per-config get_shap chain (preprocess -> balanced full-set
+    resample -> fit, pipeline._fused_shap_fit) fused with the explain
+    stage (ops/treeshap.py), mapped over the plan's padded config batch.
+    A whole-grid SHAP pass is then <= #families + O(1) dispatches of this
+    program — the same engine treatment make_plan_fn gave scores
+    (bench.py measures it as ``shap_dispatch_count``).
+
+    ``mode`` selects the explain engine, all three traceable so every
+    mode rides the same plan batch:
+    - "path"           path-dependent Tree SHAP -> [B, n_explain, F]
+    - "interventional" vs the first ``n_background`` preprocessed rows
+                       (feature_perturbation='interventional')
+                       -> [B, n_explain, F]
+    - "interaction"    SHAP interaction values -> [B, n_explain, F, F]
+
+    RNG: each member's key comes in per-slot (the executor folds the
+    canonical grid index into the seed, run_plan-style) and splits
+    kb/kf exactly like the staged shap_for_config path, so a member's
+    forest matches the per-config stage bit-for-bit when seeded alike.
+
+    Serial (mesh=None) the batch rides ``lax.map`` — one compile, one
+    dispatch, members keep their own while_loop trip counts (the
+    make_plan_fn rationale); on a mesh the batch shard_maps over the
+    "config" axis with members on the vmap axis."""
+    if mode not in ("path", "interventional", "interaction"):
+        raise ValueError(f"mode must be path|interventional|interaction, "
+                         f"got {mode!r}")
+    if mode == "interventional" and not n_background:
+        raise ValueError("interventional mode needs n_background > 0")
+    g = grower or os.environ.get("F16_ENSEMBLE_GROWER", "hist")
+    use_hist = spec.n_trees > 1 and g == "hist"
+    cap = 2 * n  # SMOTE bound, as everywhere
+    max_nodes = 2 * cap
+
+    def shap_one(x, y_raw, fl, prep, bal, key):
+        y = y_raw == fl
+        mu, wmat = fit_preprocess(x, prep)
+        xp = transform(x, mu, wmat)
+        kb, kf = jax.random.split(key)
+        xs, ys, ws = resample(xp, y, jnp.ones(n, jnp.float32), bal, kb, cap)
+        kw = dict(n_trees=spec.n_trees, bootstrap=spec.bootstrap,
+                  random_splits=spec.random_splits,
+                  sqrt_features=spec.sqrt_features,
+                  max_depth=max_depth, max_nodes=max_nodes)
+        forest = (trees.fit_forest_hist if use_hist
+                  else trees.fit_forest)(xs, ys, ws, kf, **kw)
+        xe = xp[:n_explain]
+        if mode == "interventional":
+            return treeshap._interventional_jit(
+                forest, xe, xp[:n_background], depth=max_depth,
+                row_chunk=row_chunk)
+        if mode == "interaction":
+            return treeshap._interactions_jit(
+                forest, xe, depth=max_depth, row_chunk=row_chunk)
+        return treeshap._graph_forest_shap(forest, xe, depth=max_depth)
+
+    def plan_batch(x, y_raw, fls, preps, bals, keys):
+        return jax.vmap(
+            lambda fl, prep, bal, key: shap_one(x, y_raw, fl, prep, bal,
+                                                key)
+        )(fls, preps, bals, keys)
+
+    if mesh is None:
+        def plan_batch_serial(x, y_raw, fls, preps, bals, keys):
+            return jax.lax.map(
+                lambda m: shap_one(x, y_raw, m[0], m[1], m[2], m[3]),
+                (fls, preps, bals, keys),
+            )
+        return costs.instrument(jax.jit(plan_batch_serial),
+                                "shap.plan_batch")
+    pspec = P("config")
+    return _shard_jit(mesh, plan_batch,
+                      (P(), P(), pspec, pspec, pspec, pspec),
+                      pspec, "shap.plan_batch")
 
 
 def _chunked_fit(prep_fn, fit_chunk_fn, tree_keys_thunk, fit_args, n_trees,

@@ -448,3 +448,103 @@ def write_shap(tests_file=TESTS_FILE, out_file=SHAP_FILE, *, max_depth=48,
         pickle.dump(values, fd)
     obs.emit_memory_gauges()
     return values
+
+
+@functools.lru_cache(maxsize=None)
+def _shap_plan_fn(spec, n, n_feat, max_depth, n_explain, mode,
+                  n_background, tree_overrides_tag):
+    """Cached single-device SHAP plan program per (family spec, shapes,
+    mode) — repeat shap_grid calls (bench warm + timed) must hit the
+    trace cache, like _fused_shap_fit. ``tree_overrides_tag`` keeps
+    distinct override sets from aliasing (it is already folded into
+    ``spec``; the tag only widens the cache key)."""
+    from flake16_framework_tpu.parallel.sweep import make_shap_plan_fn
+
+    return make_shap_plan_fn(spec, None, n=n, n_feat=n_feat,
+                             max_depth=max_depth, n_explain=n_explain,
+                             mode=mode, n_background=n_background)
+
+
+def shap_grid(tests_file=TESTS_FILE, out_file=None, *, mode="path",
+              n_explain=64, n_background=32, max_depth=48,
+              tree_overrides=None, seed=0, configs=None, arrays=None):
+    """Whole-grid SHAP via the planner (ISSUE 14): every config of the
+    216 grid (or ``configs``) explained in <= #families + O(1) device
+    dispatches — one fused prep->resample->fit->explain program per
+    family plan (parallel/sweep.make_shap_plan_fn), the engine treatment
+    write_scores' planner mode gave the scores sweep.
+
+    ``mode``: "path" (path-dependent Tree SHAP, the paper's semantics),
+    "interventional" (vs the first ``n_background`` preprocessed rows),
+    or "interaction" (SHAP interaction values [S, F, F]).
+
+    RNG deviation from the paper path, documented: each member seeds
+    from fold_in(PRNGKey(seed), canonical grid index) — the sweep
+    engine's per-config scheme — where shap_for_config uses the bare
+    PRNGKey(seed) for its two paper configs. The paper artifact
+    (write_shap) is untouched.
+
+    Returns {"fs/model/flaky/prep/bal" config string: values array
+    [n_explain, F] (or [n_explain, F, F] for interaction)}; with
+    ``out_file`` the dict is pickled with its mode metadata. ``arrays``
+    short-circuits the tests-file load with in-memory (feats,
+    labels_raw) — the bench's census stage runs on synthetic data."""
+    from flake16_framework_tpu.parallel import planner
+
+    if arrays is not None:
+        feats, labels = arrays[0], arrays[1]
+    else:
+        feats, labels, _, _, _ = _load_arrays(tests_file)
+    n = feats.shape[0]
+    n_explain = min(int(n_explain), n)
+    n_background = min(int(n_background), n)
+    config_list = [tuple(k) for k in (configs or cfg.iter_config_keys())]
+    plans = planner.plan_explain_grid(
+        config_list, devices=1, n=n, n_folds=0, n_explain=n_explain,
+        tree_overrides=tree_overrides)
+    obs.manifest_update(verb="shap", mode=mode,
+                        out_file=str(out_file) if out_file else None)
+    obs.record_jax_manifest()
+    ov_tag = tuple(sorted((tree_overrides or {}).items()))
+    base = jax.random.PRNGKey(seed)
+    values = {}
+    with obs.span("shap.grid", mode=mode, plans=len(plans),
+                  configs=len(config_list)):
+        for plan in plans:
+            fs_name, model_name = plan.family
+            spec = cfg.MODELS[model_name]
+            if tree_overrides and model_name in tree_overrides:
+                spec = type(spec)(spec.name, tree_overrides[model_name],
+                                  spec.bootstrap, spec.random_splits,
+                                  spec.sqrt_features)
+            cols = list(cfg.FEATURE_SETS[fs_name])
+            fn = _shap_plan_fn(spec, n, len(cols), max_depth, n_explain,
+                               mode, n_background, ov_tag)
+            batch = plan.padded_configs
+            fls = np.array([cfg.FLAKY_TYPES[k[0]] for k in batch], np.int32)
+            preps = np.array([cfg.PREPROCESSINGS[k[2]] for k in batch],
+                             np.int32)
+            bals = np.array([cfg.BALANCINGS[k[3]] for k in batch], np.int32)
+            keys = np.stack([np.asarray(jax.random.fold_in(base, idx))
+                             for idx in plan.padded_indices])
+            x = jnp.asarray(np.asarray(feats[:, cols], np.float32))
+            with obs.span("shap.plan", key=(fs_name, model_name, mode),
+                          stage="shap", batch=len(plan.configs),
+                          pad=plan.pad):
+                out = np.asarray(fn(  # blocks: the plan wall is real
+                    x, jnp.asarray(np.asarray(labels, np.int32)),
+                    jnp.asarray(fls), jnp.asarray(preps),
+                    jnp.asarray(bals), jnp.asarray(keys),
+                ))
+            for i, k in enumerate(plan.configs):  # mask: real members only
+                values["/".join(k)] = out[i]
+            obs.counter_add("shap_configs", len(plan.configs))
+    if out_file is not None:
+        payload = {"mode": mode, "n_explain": n_explain,
+                   "n_background": (n_background
+                                    if mode == "interventional" else 0),
+                   "values": values}
+        with atomic_write(out_file, "wb") as fd:
+            pickle.dump(payload, fd)
+    obs.emit_memory_gauges()
+    return values

@@ -56,8 +56,11 @@ def _shap_xla_raw(forest, mu, wmat, x, *, depth):
 
 
 def _shap_pallas_raw(forest, mu, wmat, x, *, depth):
-    return treeshap._pallas_forest_shap(forest, transform(x, mu, wmat),
-                                        depth=depth, interpret=False)
+    # _pallas_graph_shap is the TRACEABLE pallas program (the work-item
+    # kernel on the in-graph single-bucket layout); the host-packed
+    # _pallas_forest_shap driver cannot live inside an AOT executable.
+    return treeshap._pallas_graph_shap(forest, transform(x, mu, wmat),
+                                       depth=depth, interpret=False)
 
 
 class ExecutableStore:

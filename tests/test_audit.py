@@ -297,6 +297,15 @@ def test_audit_gate_package_is_clean():
     assert report["census"]["static"] == 6
     assert report["census"]["runtime"] == 6
     assert report["census"]["match"] is True
-    assert len(report["envelopes"]) == 6
+    assert report["shap_census"]["static"] == 6
+    assert report["shap_census"]["match"] is True
+    # 6 scores plans + 6 shap plans + the interventional/interaction
+    # mode programs (one family each — cost-bounding, rules_ir.run_audit)
+    names = [env["entry"] for env in report["envelopes"]]
+    assert sum(n.startswith("scores.plan_batch[") for n in names) == 6
+    assert sum(n.startswith("shap.plan_batch[") for n in names) == 6
+    assert sum(".interventional[" in n for n in names) == 1
+    assert sum(".interaction[" in n for n in names) == 1
+    assert len(report["envelopes"]) == 14
     for env in report["envelopes"]:
         assert env["peak_bytes"] > env["arg_bytes"] >= 0

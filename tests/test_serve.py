@@ -116,6 +116,36 @@ def test_registry_round_trip(registry):
             registry_mod.signature_digest(fresh.get(model_id))
 
 
+def test_reload_reuses_one_executable_per_kind_bucket(data, tmp_path):
+    """ISSUE 14 satellite: models with equal artifact shapes share ONE
+    compiled executable per (kind, bucket), and a registry reload warms
+    into the very same executables — the AOT cache must not grow."""
+    feats, labels = data
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    rf_config = ("NOD", "Flake16", "Scaling", "SMOTE Tomek",
+                 "Random Forest")
+    for keys in (ET_CONFIG, rf_config):  # same shapes, different model
+        reg.fit_and_register(keys, feats, labels, max_depth=MAX_DEPTH,
+                             tree_overrides=TINY, seed=3)
+    store = ExecutableStore(reg)
+    for model_id in reg.ids():
+        store.warm(reg.get(model_id), BUCKETS)
+    # Two models, two buckets -> exactly len(BUCKETS) executables per
+    # kind (the programs take forest/mu/W as runtime arguments).
+    assert len(store._predict._cache) == len(BUCKETS)
+    assert len(store._shap_xla._cache) == len(BUCKETS)
+    pred_keys = set(store._predict._cache)
+    shap_keys = set(store._shap_xla._cache)
+
+    fresh = ModelRegistry(reg.root)
+    fresh.load()
+    for model_id in fresh.ids():
+        store.warm(fresh.get(model_id), BUCKETS)
+    # Reload reuses: identical dispatch keys, zero new compilations.
+    assert set(store._predict._cache) == pred_keys
+    assert set(store._shap_xla._cache) == shap_keys
+
+
 def test_model_identity(registry):
     assert model_id_for(DT_CONFIG) == "nod-flake16-none-none-decisiontree"
     want = list(cfg.iter_config_keys()).index(DT_CONFIG)

@@ -62,8 +62,13 @@ HIGHER_BETTER = ("value", "scores_speedup", "shap_speedup", "serve_rps",
 # structural property (#plans), so any growth is a real engine
 # regression, but it rides the same ratio ceiling as the walls. Absent
 # from rounds <= r07, hence vacuous against them.
+# shap_dispatch_count / shap_interact_s (round 9+, the ISSUE-14 SHAP
+# arm): the same census for the whole-grid fused explain pass, and the
+# warm interaction-mode wall. Absent from rounds <= r08, hence vacuous
+# against them.
 LOWER_BETTER = ("t_ours_scores_s", "t_ours_shap_s", "t_ours_fit_s",
-                "serve_p99_ms", "grid_dispatch_count")
+                "serve_p99_ms", "grid_dispatch_count",
+                "shap_dispatch_count", "shap_interact_s")
 
 
 def load_history(repo=REPO):
