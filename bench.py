@@ -1087,6 +1087,7 @@ def serve_bench():
     configure_jax_cache()
 
     from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.obs.slo import SLOConfig
     from flake16_framework_tpu.serve.cli import sustained_load
     from flake16_framework_tpu.serve.registry import ModelRegistry
     from flake16_framework_tpu.serve.service import ScoringService
@@ -1102,12 +1103,18 @@ def serve_bench():
                                   tree_overrides=overrides, persist=False)
     t_fit = time.time() - t0
 
+    # SLO monitor rides along (ISSUE 15b): a deliberately generous p99
+    # objective (the reference workload runs ~7ms) so healthy rounds
+    # record serve_shed_pct = 0 — sustained shedding on THIS load is the
+    # regression the r10+ gate watches for, not an expected steady state.
+    slo_cfg = SLOConfig(p99_ms=250.0)
     t0 = time.time()
-    with ScoringService(registry) as svc:
+    with ScoringService(registry, slo=slo_cfg) as svc:
         t_warm = time.time() - t0
         result = sustained_load(
             svc, feats, registry.ids(), n_requests=SERVE_REQUESTS,
             rows=SERVE_ROWS, kinds=("predict",), clients=SERVE_CLIENTS)
+        slo = svc.slo_summary() or {}
 
     print(json.dumps({
         "metric": "serve_sustained_rps",
@@ -1127,6 +1134,11 @@ def serve_bench():
             "warm_s": round(t_warm, 2),
             "n_tests": SERVE_N_TESTS,
             "n_trees": SERVE_N_TREES,
+            "serve_shed_pct": slo.get("serve_shed_pct"),
+            "slo_worst_burn_fast": slo.get("worst_burn_fast"),
+            "slo_worst_burn_slow": slo.get("worst_burn_slow"),
+            "slo_time_in_degraded_s": slo.get("time_in_degraded_s"),
+            "slo_breaches": slo.get("breaches"),
             "backend": jax.default_backend(),
         },
     }))

@@ -115,6 +115,14 @@ KNOBS = {
     # pre-flight refuses any family program whose peak-memory envelope
     # exceeds it (parallel/sweep._preflight_plan_budget, I401).
     "F16_DEVICE_BUDGET_MB": ("float", 0.0),
+    # observability plane (ISSUE 15): per-request trace sampling rate
+    # (obs/core.mint_trace; 0 disables, 1 samples every request), the
+    # jax.profiler capture directory for the plan/serve dispatch hooks
+    # (obs/core.xprof_trace), and the crash-surviving flight-ring arming
+    # path (obs/flight.py; "1" = <run_dir>/flight.bin).
+    "F16_TRACE_SAMPLE": ("float", 0.0),
+    "F16_XPROF": ("str", None),
+    "F16_FLIGHT": ("str", None),
 }
 
 # The PAPER's grid size — historical reference only. The pre-flight's

@@ -41,6 +41,10 @@ RULES = {r.id: r for r in (
              "event kind emitted in code but absent from"
              " schema.EVENT_FIELDS, or declared there but never emitted"
              " — two-way wire-schema drift"),
+    RuleInfo("O105", ERROR,
+             "gauge/counter emitted at a call site but unregistered in"
+             " the metrics census (obs/metrics.py METRIC_CENSUS) —"
+             " invisible to the live exporter"),
 )}
 
 # Kinds whose emitters live OUTSIDE the package lint scope (the default
@@ -55,8 +59,25 @@ _SPAN_NAME_RE = re.compile(r"^[a-z0-9_.]+$")
 def check_module(mod):
     findings = []
     for node in ast.walk(mod.tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)):
+        if not isinstance(node, ast.Call):
+            continue
+        # O105 covers both call forms — obs.gauge("n", ...) and core.py's
+        # own bare gauge("n", ...) — mirroring the O104 census discipline.
+        fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else node.func.id if isinstance(node.func, ast.Name) else None
+        if fname in ("gauge", "counter_add") and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            from flake16_framework_tpu.obs.metrics import METRIC_CENSUS
+
+            name = node.args[0].value
+            if name not in METRIC_CENSUS:
+                findings.append(mod.finding(
+                    "O105", RULES["O105"].severity, node,
+                    f"metric {name!r} is emitted here but unregistered "
+                    "in obs/metrics.py METRIC_CENSUS — the live "
+                    "exporter's census cannot see it"))
+        if not isinstance(node.func, ast.Attribute):
             continue
         if node.func.attr == "event" and node.args \
                 and isinstance(node.args[0], ast.Constant) \

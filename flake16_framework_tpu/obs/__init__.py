@@ -25,10 +25,12 @@ from flake16_framework_tpu.obs.core import (  # noqa: F401
     gauge,
     host_rss_peak_mb,
     manifest_update,
+    mint_trace,
     profiler_trace,
     record_jax_manifest,
     shutdown,
     span,
     start_heartbeat,
     stop_heartbeat,
+    xprof_trace,
 )

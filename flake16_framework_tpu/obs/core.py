@@ -28,6 +28,7 @@ must not silently depend on F16_TELEMETRY).
 
 import json
 import os
+import random
 import subprocess
 import sys
 import threading
@@ -38,6 +39,8 @@ from flake16_framework_tpu.obs import schema
 _lock = threading.Lock()
 _state = None  # _RunState when enabled; module-level None = the fast path
 _run_seq = 0   # disambiguates same-second reconfigures within one process
+_flight = None  # obs.flight.FlightRecorder when F16_FLIGHT armed
+_xprof_done = set()  # tags already captured (one xprof per process+tag)
 
 
 class _NullSpan:
@@ -61,7 +64,7 @@ _NULL_SPAN = _NullSpan()
 
 
 class _RunState:
-    __slots__ = ("run", "dir", "fd", "t0", "counters", "seen",
+    __slots__ = ("run", "dir", "fd", "t0", "counters", "gauges", "seen",
                  "hb_stop", "hb_thread")
 
     def __init__(self, run, run_dir, fd):
@@ -70,6 +73,7 @@ class _RunState:
         self.fd = fd
         self.t0 = time.time()
         self.counters = {}
+        self.gauges = {}  # name -> last emitted value (manifest flush)
         self.seen = set()  # (span name, key) pairs already timed once
         self.hb_stop = None
         self.hb_thread = None
@@ -99,6 +103,12 @@ def _emit(state, obj):
     line = (json.dumps(obj) + "\n").encode()
     with _lock:
         os.write(state.fd, line)
+    flt = _flight
+    if flt is not None:  # mirror into the crash-surviving ring
+        try:
+            flt.record(obj)
+        except (OSError, ValueError):
+            pass
 
 
 # -- lifecycle ----------------------------------------------------------
@@ -139,6 +149,7 @@ def configure(root=None, heartbeat_s=None):
                  os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
     _state = _RunState(run, run_dir, fd)
     _write_manifest_base(_state)
+    _arm_flight(run_dir)
     if heartbeat_s is None:
         heartbeat_s = float(os.environ.get("F16_TELEMETRY_HEARTBEAT_S",
                                            "60") or 0)
@@ -151,15 +162,37 @@ def shutdown():
     """Stop the heartbeat, close the sink, return to the disabled state.
     Final manifest facts (compilation-cache directory and traffic) are
     stamped first, while the sink is still up."""
-    global _state
+    global _state, _flight
     if _state is not None:
         _finalize_manifest()
     state, _state = _state, None
+    flt, _flight = _flight, None
+    if flt is not None:
+        flt.close()
     if state is None:
         return
     stop_heartbeat(state)
     with _lock:
         os.close(state.fd)
+
+
+def _arm_flight(run_dir):
+    """Arm the crash-surviving flight ring when F16_FLIGHT is set (off by
+    default, same contract as the sink). Once armed, ``_emit`` mirrors
+    every event into the ring."""
+    global _flight
+    from flake16_framework_tpu.obs import flight as _flightmod
+
+    path = _flightmod.env_path(run_dir=run_dir)
+    if not path:
+        return
+    try:
+        _flight = _flightmod.FlightRecorder(path)
+    except OSError:
+        _flight = None
+        return
+    event("flight", action="armed", path=str(path),
+          capacity=_flight.capacity)
 
 
 def _finalize_manifest():
@@ -190,6 +223,11 @@ def _finalize_manifest():
             fields["jax_cache_misses"] = int(stats.get("misses", 0))
         except Exception:
             pass
+    state = _state
+    if state is not None and state.gauges:
+        # Gauge last-values ride the same flush (heartbeat + shutdown +
+        # flight dump): a SIGKILL'd serve keeps its final queue-depth/p99.
+        fields["gauges"] = dict(state.gauges)
     if fields:
         manifest_update(**fields)
 
@@ -272,8 +310,9 @@ def gauge(name, value, **fields):
     state = _state
     if state is None or value is None:
         return
-    _emit(state, {"kind": "gauge", "name": name,
-                  "value": round(float(value), 4), **fields})
+    value = round(float(value), 4)
+    state.gauges[name] = value  # last-value, flushed into the manifest
+    _emit(state, {"kind": "gauge", "name": name, "value": value, **fields})
 
 
 def event(kind, **fields):
@@ -318,6 +357,32 @@ def emit_memory_gauges():
         return
     gauge("host_rss_peak_mb", host_rss_peak_mb())
     gauge("device_mem_peak_mb", device_memory_peak_mb())
+
+
+# -- per-request trace context ------------------------------------------
+
+
+def mint_trace(parent=None):
+    """Trace context for one request: ``{trace_id, span_id[, parent_id]}``
+    or None when telemetry is off or the request loses the
+    ``F16_TRACE_SAMPLE`` coin flip (default 1.0 = every request; 0
+    disables). Minted at ``serve.submit()`` and propagated
+    queue→batcher→dispatch→response; the batcher records batch fan-in as
+    span links and stamps per-request lanes the trace renderer draws next
+    to the per-thread lanes."""
+    if _state is None:
+        return None
+    try:
+        rate = float(os.environ.get("F16_TRACE_SAMPLE", "1") or 0.0)
+    except ValueError:
+        rate = 0.0
+    if rate <= 0.0 or (rate < 1.0 and random.random() >= rate):
+        return None
+    ctx = {"trace_id": os.urandom(8).hex(), "span_id": os.urandom(4).hex()}
+    if parent:
+        ctx["parent_id"] = parent.get("span_id")
+        ctx["trace_id"] = parent.get("trace_id", ctx["trace_id"])
+    return ctx
 
 
 # -- manifest -----------------------------------------------------------
@@ -485,6 +550,19 @@ class profiler_trace:
         if self._cm is not None:
             return self._cm.__exit__(*exc)
         return False
+
+
+def xprof_trace(tag):
+    """Device-profiler hook: one ``jax.profiler`` capture per
+    (process, tag) into ``$F16_XPROF/<tag>`` — armed around the plan and
+    serve dispatch sites so the first silicon session banks a real device
+    profile without a second run. Unarmed (knob unset) or already
+    captured → the no-op ``profiler_trace(None)``."""
+    trace_dir = os.environ.get("F16_XPROF", "")
+    if not trace_dir or tag in _xprof_done:
+        return profiler_trace(None)
+    _xprof_done.add(tag)
+    return profiler_trace(os.path.join(trace_dir, tag))
 
 
 _maybe_configure_from_env()

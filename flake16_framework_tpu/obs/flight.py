@@ -1,0 +1,289 @@
+"""Flight recorder: a crash-surviving ring buffer of the last N
+telemetry events (ISSUE 15 tentpole, part 4).
+
+The JSONL sink is append-only and unbounded — perfect evidence, terrible
+black box: a SIGKILL'd serving worker leaves a sink whose useful tail is
+buried in hours of events, and a worker running with telemetry pointed
+at a slow filesystem may lose its final seconds entirely to page-cache
+latency. The flight recorder is the complement: a FIXED-SIZE mmap'd
+ring file holding only the most recent events, written with the
+journal's CRC record discipline (resilience/journal.py), so the parent
+supervisor can replay a valid tail out of the corpse no matter where
+the kill landed.
+
+Arming — same contract as ``F16_TELEMETRY``: unset/empty = off with
+zero overhead; ``F16_FLIGHT=1`` = ring at ``<run_dir>/flight.bin``;
+any other value = the ring file path (what the supervisor and the
+chaos drill use — the parent must know the path to dump it). When
+armed, ``obs.core._emit`` mirrors every event into the ring.
+
+On-disk format (PROFILE.md "Observability plane"):
+
+- 64-byte header: ``<8sIIQQ`` — magic ``F16FLT01``, version, capacity
+  (ring bytes, excluding the header), ``head`` and ``tail`` (logical
+  monotonic byte offsets; the ring region holds bytes
+  ``[head % cap, tail % cap)`` wrap-around).
+- records: ``<II`` (payload length, crc32) + UTF-8 JSON payload, the
+  journal's framing with JSON instead of pickle (the replayer runs in
+  a DIFFERENT process — the supervisor — and must never unpickle a
+  corpse's bytes).
+
+Torn-tail rule (journal-style, longest valid prefix): the writer makes
+room by advancing ``head`` past whole old records, writes the record
+bytes, THEN publishes ``tail`` — so a kill between any two instructions
+leaves ``[head, tail)`` a valid record sequence and at worst an
+unpublished (invisible) torn record past ``tail``. ``replay`` walks
+records from ``head``, validating length sanity + CRC, and stops at the
+first invalid record with ``torn=True`` instead of failing.
+"""
+
+import json
+import mmap
+import os
+import struct
+import sys
+import threading
+import time
+import zlib
+
+_MAGIC = b"F16FLT01"
+_VERSION = 1
+_HEADER = struct.Struct("<8sIIQQ")  # magic, version, capacity, head, tail
+HEADER_SIZE = 64
+_REC = struct.Struct("<II")         # payload length, crc32(payload)
+DEFAULT_CAPACITY = 1 << 18          # 256 KiB of tail ~ thousands of events
+
+
+class FlightRecorder:
+    """The writer half: an mmap'd ring this process appends events to.
+
+    Opening RESETS the ring (head = tail = 0): one process = one flight;
+    the previous occupant's tail is the supervisor's to dump BEFORE it
+    restarts the child. ``record`` is called under obs.core's emit path
+    only (telemetry on + F16_FLIGHT armed), so the disabled path stays
+    zero-overhead."""
+
+    def __init__(self, path, capacity=DEFAULT_CAPACITY):
+        self.path = path
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._head = 0
+        self._tail = 0
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            os.ftruncate(fd, HEADER_SIZE + self.capacity)
+            self._mm = mmap.mmap(fd, HEADER_SIZE + self.capacity)
+        finally:
+            os.close(fd)
+        self._write_header()
+
+    def _write_header(self):
+        _HEADER.pack_into(self._mm, 0, _MAGIC, _VERSION, self.capacity,
+                          self._head, self._tail)
+
+    def _record_size_at(self, pos):
+        """Whole-record size (framing + payload) at logical offset
+        ``pos`` — the writer's room-making step; [head, tail) is valid
+        by construction so the prefix is always readable."""
+        prefix = self._read_ring(pos, _REC.size)
+        length, _ = _REC.unpack(prefix)
+        return _REC.size + length
+
+    def _read_ring(self, pos, n):
+        cap = self.capacity
+        off = pos % cap
+        first = min(n, cap - off)
+        out = self._mm[HEADER_SIZE + off:HEADER_SIZE + off + first]
+        if first < n:
+            out += self._mm[HEADER_SIZE:HEADER_SIZE + (n - first)]
+        return out
+
+    def _write_ring(self, pos, data):
+        cap = self.capacity
+        off = pos % cap
+        first = min(len(data), cap - off)
+        self._mm[HEADER_SIZE + off:HEADER_SIZE + off + first] = data[:first]
+        if first < len(data):
+            self._mm[HEADER_SIZE:HEADER_SIZE + len(data) - first] = \
+                data[first:]
+
+    def record(self, obj):
+        """Append one event dict; oldest records fall off the ring."""
+        payload = json.dumps(obj, default=str).encode()
+        rec = _REC.pack(len(payload), zlib.crc32(payload)) + payload
+        if len(rec) > self.capacity:
+            return  # pathological single record; never wedge the ring
+        with self._lock:
+            # Make room: advance head past whole old records, publish it
+            # BEFORE overwriting their bytes (a kill mid-write must not
+            # leave head pointing into clobbered bytes).
+            while self._tail + len(rec) - self._head > self.capacity:
+                self._head += self._record_size_at(self._head)
+            self._write_header()
+            self._write_ring(self._tail, rec)
+            self._tail += len(rec)
+            self._write_header()
+
+    def close(self):
+        try:
+            self._mm.flush()
+            self._mm.close()
+        except (ValueError, OSError):
+            pass
+
+
+# -- replay (the parent / report side; plain reads, no mmap) ------------
+
+
+def replay(path):
+    """(records, meta) from a flight ring file — the longest valid
+    record prefix of ``[head, tail)``. ``meta`` carries head/tail, the
+    record count, and ``torn`` (True when an invalid record cut the walk
+    short — expected after a kill mid-append, never an error)."""
+    with open(path, "rb") as fd:
+        blob = fd.read()
+    if len(blob) < HEADER_SIZE:
+        raise ValueError(f"flight file {path!r} too short for a header")
+    magic, version, cap, head, tail = _HEADER.unpack_from(blob, 0)
+    if magic != _MAGIC:
+        raise ValueError(f"flight file {path!r} has bad magic {magic!r}")
+    ring = blob[HEADER_SIZE:HEADER_SIZE + cap]
+
+    def ring_read(pos, n):
+        off = pos % cap
+        first = min(n, cap - off)
+        out = ring[off:off + first]
+        if first < n:
+            out += ring[:n - first]
+        return out
+
+    records = []
+    torn = False
+    pos = head
+    while pos + _REC.size <= tail:
+        length, crc = _REC.unpack(ring_read(pos, _REC.size))
+        if length > cap - _REC.size or pos + _REC.size + length > tail:
+            torn = True
+            break
+        payload = ring_read(pos + _REC.size, length)
+        if zlib.crc32(payload) != crc:
+            torn = True
+            break
+        try:
+            records.append(json.loads(payload))
+        except ValueError:
+            torn = True
+            break
+        pos += _REC.size + length
+    if pos != tail and not torn:
+        torn = True  # trailing bytes too short for a record prefix
+    return records, {"head": head, "tail": tail, "capacity": cap,
+                     "n": len(records), "torn": torn,
+                     "valid_end": pos}
+
+
+def last_gauges(records):
+    """{gauge name: last value} over a replayed record list — the
+    killed process's final readings (queue depth, p99, memory)."""
+    out = {}
+    for ev in records:
+        if ev.get("kind") == "gauge" and isinstance(
+                ev.get("value"), (int, float)):
+            out[ev.get("name", "?")] = ev["value"]
+    return out
+
+
+def flush_gauges_to_manifest(records, root=None, out=None):
+    """Merge a replayed flight's gauge last-values into the dead run's
+    manifest.json (the ISSUE-15 satellite: a SIGKILL'd serve process
+    keeps its final queue-depth/p99 readings even though its own
+    heartbeat/shutdown flush never ran). The run directory is found by
+    the records' ``run`` token under ``root`` (default: the telemetry
+    root). Returns the list of manifest paths updated."""
+    from flake16_framework_tpu.obs import core, schema
+    from flake16_framework_tpu.utils.atomic import atomic_write
+
+    root = root or core.default_root()
+    updated = []
+    by_run = {}
+    for ev in records:
+        run = ev.get("run")
+        if isinstance(run, str):
+            by_run.setdefault(run, []).append(ev)
+    for run, evs in by_run.items():
+        gauges = last_gauges(evs)
+        if not gauges:
+            continue
+        path = os.path.join(root, f"run-{run}", schema.MANIFEST_FILE)
+        if not os.path.isfile(path):
+            continue
+        try:
+            with open(path) as fd:
+                manifest = json.load(fd)
+        except (OSError, ValueError):
+            continue
+        manifest.setdefault("gauges", {}).update(gauges)
+        manifest["flight_dump_ts"] = round(time.time(), 4)
+        with atomic_write(path, "w") as fd:
+            json.dump(manifest, fd, indent=1, default=str)
+        updated.append(path)
+        if out is not None:
+            out.write(f"flight: flushed {len(gauges)} gauge last-value(s) "
+                      f"into {path}\n")
+    return updated
+
+
+def dump(path, out=None, last=40, flush_manifest=True):
+    """Replay ``path`` and pretty-print its tail — the supervisor's
+    child-death hook and the ``report --flight`` body. Also flushes
+    gauge last-values into the dead run's manifest (see above) and
+    writes the full replay next to the ring as ``<path>.dump.json``.
+    Returns the (records, meta) pair; never raises on a torn tail."""
+    from flake16_framework_tpu.obs import core
+    from flake16_framework_tpu.utils.atomic import atomic_write
+
+    out = out or sys.stdout
+    records, meta = replay(path)
+    core.event("flight", action="dump", path=str(path), n=meta["n"],
+               torn=meta["torn"])
+    out.write(f"flight {path}: {meta['n']} record(s), "
+              f"bytes [{meta['head']}, {meta['tail']})"
+              + (" — TORN tail (valid prefix shown)\n" if meta["torn"]
+                 else "\n"))
+    gauges = last_gauges(records)
+    if gauges:
+        out.write("final gauges: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(gauges.items())) + "\n")
+    for ev in records[-last:]:
+        ts = ev.get("ts")
+        stamp = time.strftime("%H:%M:%S", time.localtime(ts)) \
+            if isinstance(ts, (int, float)) else "?"
+        fields = {k: v for k, v in ev.items()
+                  if k not in ("kind", "ts", "run")}
+        out.write(f"  {stamp} {ev.get('kind', '?'):<10} "
+                  + " ".join(f"{k}={v}" for k, v in fields.items())[:160]
+                  + "\n")
+    dump_path = str(path) + ".dump.json"
+    with atomic_write(dump_path, "w") as fd:
+        json.dump({"meta": meta, "gauges": gauges, "records": records},
+                  fd, indent=1, default=str)
+    out.write(f"wrote {dump_path}\n")
+    if flush_manifest:
+        flush_gauges_to_manifest(records, out=out)
+    return records, meta
+
+
+def env_path(environ=None, run_dir=None):
+    """The armed flight-ring path from ``F16_FLIGHT`` (None = off).
+    ``1`` means ``<run_dir>/flight.bin`` — only resolvable with an
+    active run; an explicit value is the path itself (the form the
+    supervisor can dump)."""
+    env = os.environ if environ is None else environ
+    raw = env.get("F16_FLIGHT", "")
+    if not raw:
+        return None
+    if raw == "1":
+        return os.path.join(run_dir, "flight.bin") if run_dir else None
+    return raw

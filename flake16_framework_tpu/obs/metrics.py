@@ -1,0 +1,323 @@
+"""Live metrics plane: pull-based registry + Prometheus-text exporter
+(ISSUE 15 tentpole, part 1).
+
+Everything the JSONL sink records is post-hoc; this module is the LIVE
+complement — the same signals (queue depth, latency quantiles, ladder
+rung, cache traffic, memory peaks) readable while the process runs,
+in the Prometheus text exposition format, from a stdlib-HTTP thread.
+Pull-based on purpose: sources are zero-cost closures sampled only when
+a scraper actually asks, so an unscraped (or unserved) registry costs
+nothing on the hot path — the same contract as ``F16_TELEMETRY``.
+
+Wiring: ``serve --metrics-port N`` stands a ``MetricsServer`` up beside
+the scoring service (port 0 = ephemeral, the smoke tool's mode);
+``register_process_sources`` contributes the process-wide metrics and
+``ScoringService`` registers its own serve/SLO sources on start. The
+exporter reads collaborator modules via ``sys.modules`` only — metrics
+must never be the thing that initializes jax or the AOT store.
+
+``METRIC_CENSUS`` is the lint contract (analysis/rules_obs.py O105):
+every ``obs.gauge``/``obs.counter_add`` literal name emitted anywhere in
+the package must be declared here, so a metric cannot silently exist in
+the event stream while being invisible to the live exporter's census.
+"""
+
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from flake16_framework_tpu.obs import core
+
+# Every gauge/counter NAME the package emits through obs.gauge /
+# obs.counter_add (rules_obs.O105 enforces the census both ways with
+# the same two-way discipline as the event-kind census O104).
+METRIC_CENSUS = frozenset({
+    # serve/batcher.py + serve/service.py
+    "serve.requests", "serve.queue_depth", "serve.p50_ms", "serve.p99_ms",
+    "serve.inflight", "serve.shed",
+    # obs/core.py memory gauges
+    "host_rss_peak_mb", "device_mem_peak_mb",
+    # parallel/sweep.py grid totals
+    "configs", "folds", "trees",
+    # pipeline.py SHAP grid totals
+    "shap_configs",
+})
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _format_value(value):
+    value = float(value)
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(value)
+
+
+def _escape_label(value):
+    return str(value).replace("\\", r"\\").replace('"', r"\"") \
+        .replace("\n", r"\n")
+
+
+class MetricsRegistry:
+    """Named pull sources. ``register(name, fn)`` takes a zero-arg
+    closure returning a number (one sample), a dict (fan-out to
+    ``name{name="key"}`` labeled samples), or None (source currently
+    absent — e.g. device memory on CPU — and skipped, never 0-faked)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sources = {}  # name -> (kind, help text, fn)
+
+    def register(self, name, fn, kind="gauge", help=""):
+        with self._lock:
+            self._sources[name] = (kind, help, fn)
+
+    def unregister(self, name):
+        with self._lock:
+            self._sources.pop(name, None)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._sources)
+
+    def collect(self):
+        """[(name, kind, help, [(labels or None, value), ...])] for every
+        source that currently yields a value; a raising or None source is
+        skipped (the exporter must survive any collaborator's state)."""
+        with self._lock:
+            items = sorted(self._sources.items())
+        out = []
+        for name, (kind, help_text, fn) in items:
+            try:
+                value = fn()
+            except Exception:
+                continue
+            if value is None:
+                continue
+            if isinstance(value, dict):
+                samples = [({"name": str(k)}, float(v))
+                           for k, v in sorted(value.items())
+                           if isinstance(v, (int, float))]
+                if not samples:
+                    continue
+            else:
+                try:
+                    samples = [(None, float(value))]
+                except (TypeError, ValueError):
+                    continue
+            out.append((name, kind, help_text, samples))
+        return out
+
+    def render(self):
+        """The Prometheus text exposition body (format 0.0.4)."""
+        lines = []
+        for name, kind, help_text, samples in self.collect():
+            if help_text:
+                lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {kind}")
+            for labels, value in samples:
+                label_s = ""
+                if labels:
+                    label_s = "{" + ",".join(
+                        f'{k}="{_escape_label(v)}"'
+                        for k, v in sorted(labels.items())) + "}"
+                lines.append(f"{name}{label_s} {_format_value(value)}")
+        return "\n".join(lines) + "\n"
+
+
+def register_process_sources(registry):
+    """Contribute the process-wide sources every exporter shares:
+    memory peaks, ladder rung, AOT dispatch census, persistent-cache
+    traffic, journal fold lag, and the telemetry counter totals. All
+    read collaborators via ``sys.modules``/getattr — never initialize."""
+    from flake16_framework_tpu.resilience import ladder
+
+    registry.register(
+        "f16_uptime_seconds", lambda: _run_uptime_s(),
+        help="Wall seconds since the telemetry run started (or None "
+             "with telemetry off).")
+    registry.register(
+        "f16_host_rss_peak_mb", core.host_rss_peak_mb,
+        help="Peak resident set size of this process, MiB.")
+    registry.register(
+        "f16_device_mem_peak_mb", core.device_memory_peak_mb,
+        help="Peak device memory over local devices, MB (absent where "
+             "the backend does not report it).")
+    registry.register(
+        "f16_ladder_halvings", lambda: ladder.state().halvings,
+        help="Degradation-ladder chunk halvings taken (0 = top rung).")
+    registry.register(
+        "f16_ladder_cpu_fallback",
+        lambda: int(ladder.state().cpu_fallback),
+        help="1 while the ladder pins dispatches to host CPU.")
+    registry.register(
+        "f16_ladder_pallas_broken",
+        lambda: int(ladder.state().pallas_broken)
+        + len(ladder.state().pallas_broken_kernels),
+        help="Pallas->xla rungs currently taken across kernels.")
+    registry.register(
+        "f16_aot_dispatches_total", lambda: _aot_stat("dispatches"),
+        kind="counter",
+        help="AOT executable dispatches since process start.")
+    registry.register(
+        "f16_aot_compiles_total", lambda: _aot_stat("compiles"),
+        kind="counter",
+        help="AOT executable compiles since process start.")
+    registry.register(
+        "f16_jax_cache_hits_total", lambda: _aot_cache_stat("hits"),
+        kind="counter",
+        help="Persistent XLA compilation-cache hits observed.")
+    registry.register(
+        "f16_jax_cache_misses_total", lambda: _aot_cache_stat("misses"),
+        kind="counter",
+        help="Persistent XLA compilation-cache misses observed.")
+    registry.register(
+        "f16_journal_fold_lag_seconds", _journal_fold_lag,
+        help="Seconds since the last sweep-journal append in this "
+             "process (absent before any append).")
+    registry.register(
+        "f16_events_total", _counter_totals, kind="counter",
+        help="Telemetry counter totals by name (the obs.counter_add "
+             "census, labeled).")
+    return registry
+
+
+def _run_uptime_s():
+    state = core._state
+    if state is None:
+        return None
+    import time
+
+    return round(time.time() - state.t0, 3)
+
+
+def _aot_stat(field):
+    aot = sys.modules.get("flake16_framework_tpu.obs.aot")
+    if aot is None:
+        return None
+    return int(aot.dispatch_stats().get(field, 0))
+
+
+def _aot_cache_stat(field):
+    aot = sys.modules.get("flake16_framework_tpu.obs.aot")
+    if aot is None:
+        return None
+    return int(aot.cache_stats().get(field, 0))
+
+
+def _journal_fold_lag():
+    journal = sys.modules.get("flake16_framework_tpu.resilience.journal")
+    if journal is None:
+        return None
+    return journal.fold_lag_s()
+
+
+def _counter_totals():
+    state = core._state
+    if state is None:
+        return None
+    with core._lock:
+        return dict(state.counters) or None
+
+
+class MetricsServer:
+    """The exporter: a ThreadingHTTPServer daemon thread serving
+    ``GET /metrics`` off a registry. ``port=0`` binds an ephemeral port
+    (the smoke tool reads ``self.port`` after construction); bound to
+    loopback by default — exposing a fleet endpoint is the operator's
+    explicit ``host=`` decision, not a default."""
+
+    def __init__(self, registry, port=0, host="127.0.0.1"):
+        self.registry = registry
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                body = server.registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass  # scrapes must not spam the serving process's stderr
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="f16-metrics",
+            daemon=True)
+        self._thread.start()
+        core.event("metrics", action="serve", port=self.port,
+                   n_metrics=len(self.registry.names()))
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        core.event("metrics", action="stop", port=self.port)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def validate_exposition(text):
+    """Problems with a Prometheus text body (empty list = valid) — the
+    grammar subset we emit: HELP/TYPE comments, bare and labeled samples
+    with finite numeric values. Shared by tools/metrics_smoke.py and the
+    tier-1 tests so the endpoint and the validator cannot drift."""
+    import re
+
+    problems = []
+    typed = set()
+    sample_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+        r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\""
+        r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\")*\})?"
+        r" -?[0-9.eE+-]+$")
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) < 4 or parts[3] not in (
+                    "gauge", "counter", "histogram", "summary", "untyped"):
+                problems.append(f"line {i}: malformed TYPE: {line!r}")
+            else:
+                typed.add(parts[2])
+            continue
+        if line.startswith("# HELP "):
+            if len(line.split(None, 3)) < 4:
+                problems.append(f"line {i}: malformed HELP: {line!r}")
+            continue
+        if line.startswith("#"):
+            continue
+        if not sample_re.match(line):
+            problems.append(f"line {i}: malformed sample: {line!r}")
+            continue
+        name = re.split(r"[{ ]", line, maxsplit=1)[0]
+        if name not in typed:
+            problems.append(
+                f"line {i}: sample {name!r} precedes its # TYPE line")
+        try:
+            float(line.rsplit(" ", 1)[1])
+        except ValueError:
+            problems.append(f"line {i}: non-numeric value: {line!r}")
+    if not typed:
+        problems.append("no metrics exposed")
+    return problems

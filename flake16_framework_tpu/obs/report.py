@@ -399,6 +399,7 @@ def report_main(args, out=None):
     out = out or sys.stdout
     as_json = False
     attrib = False
+    flight = False
     top = 15
     root = None
     path = None
@@ -408,6 +409,8 @@ def report_main(args, out=None):
             as_json = True
         elif a == "--attrib":
             attrib = True
+        elif a == "--flight":
+            flight = True
         elif a == "--top":
             raw = next(it, None)
             if raw is None:
@@ -423,6 +426,26 @@ def report_main(args, out=None):
             path = a
         else:
             raise ValueError(f"Unrecognized report argument {a!r}")
+    if flight:
+        # ``report --flight [PATH]``: PATH may be the ring file itself
+        # (the supervisor's explicit-path form) or a run dir holding
+        # flight.bin; default = the latest run's ring.
+        from flake16_framework_tpu.obs import flight as _flight
+
+        if path is not None and os.path.isfile(path):
+            ring = path
+        else:
+            ring = os.path.join(find_run_dir(path, root), "flight.bin")
+        if not os.path.isfile(ring):
+            raise SystemExit(
+                f"no flight record at {ring!r} — arm one with "
+                "F16_FLIGHT=1 (see PROFILE.md 'Observability plane')")
+        records, meta = _flight.dump(ring, out=out, flush_manifest=False)
+        if as_json:
+            out.write(json.dumps(
+                {"meta": meta, "gauges": _flight.last_gauges(records)},
+                indent=1, default=str) + "\n")
+        return {"meta": meta, "records": records}
     run_dir = find_run_dir(path, root)
     manifest, events = load_run(run_dir)
     if attrib:

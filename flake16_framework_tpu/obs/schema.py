@@ -82,6 +82,19 @@ EVENT_FIELDS = {
     # the 1-based restart number; extra fields ``rc`` (the death the
     # restart answers, negative = killed by that signal) and ``budget``.
     "restart": {"attempt": int},
+    # Metrics-exporter lifecycle (obs/metrics.py): ``action`` is
+    # serve | stop. Serve carries ``port`` and ``n_metrics`` (registered
+    # sources at bind time).
+    "metrics": {"action": str},
+    # SLO monitor transition (obs/slo.py): ``state`` is breach |
+    # recovered; both carry the fast/slow burn rates at the transition.
+    # Extra fields: ``p99_ms``, ``error_rate``, ``shed_total``, and on
+    # breach ``degraded`` (whether the pallas→xla rung was taken).
+    "slo": {"state": str, "burn_fast": _NUM, "burn_slow": _NUM},
+    # Flight-recorder lifecycle (obs/flight.py): ``action`` is
+    # armed | dump. Armed carries ``path``/``capacity``; dump carries
+    # ``path``/``n`` (replayed records) and ``torn``.
+    "flight": {"action": str},
 }
 
 MANIFEST_FIELDS = {

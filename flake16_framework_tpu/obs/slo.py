@@ -1,0 +1,212 @@
+"""SLO monitor: multi-window burn-rate evaluation that ACTUATES
+(ISSUE 15 tentpole, part 3).
+
+ROADMAP item 5 specifies load-shedding "driven by the existing telemetry
+gauges" — this closes that loop. The serving stack declares objectives
+(p99 latency, error rate) in its config; the monitor folds every
+completed request into two sliding windows (fast + slow, the classic
+multi-window burn-rate alarm: the fast window reacts, the slow window
+keeps one latency spike from flapping the fleet) and on each evaluation
+compares the measured burn — the rate at which the error/latency budget
+is being spent, 1.0 = exactly on budget — against trip thresholds.
+
+Transitions do two things, in order:
+
+- **actuate**: entering breach starts SHEDDING (``serve``'s admission
+  path rejects new submits with ``RequestRejected`` while
+  ``monitor.shedding`` — bounded-admission rejection, the queue never
+  grows into the latency it is supposed to cure) and, when configured,
+  steps the pallas→xla degradation ladder
+  (``ladder.mark_pallas_broken``) so the hot kernel sheds compile/replay
+  risk too; recovery clears shedding and releases the rung — but only
+  the rung the monitor itself took (a rung taken by a real Mosaic fault
+  stays down).
+- **witness**: every transition emits an ``slo`` event with both burns
+  (the drill in tests/test_obs_plane.py asserts the whole loop from
+  these events alone).
+"""
+
+import threading
+import time
+
+from flake16_framework_tpu.obs import core
+
+
+class SLOConfig:
+    """Declared objectives + evaluation windows for one serving process.
+
+    ``latency_budget``/``error_budget`` are the tolerated fractions of
+    requests over-objective / failed; burn = measured fraction divided
+    by budget (1.0 = spending exactly on budget). A breach requires BOTH
+    windows >= ``shed_burn``; recovery requires the fast window back
+    under ``clear_burn``. ``min_events`` keeps an idle or cold window
+    from evaluating on noise."""
+
+    __slots__ = ("p99_ms", "latency_budget", "error_budget",
+                 "fast_window_s", "slow_window_s", "shed_burn",
+                 "clear_burn", "min_events", "degrade", "kernel")
+
+    def __init__(self, p99_ms=50.0, latency_budget=0.05, error_budget=0.02,
+                 fast_window_s=5.0, slow_window_s=30.0, shed_burn=2.0,
+                 clear_burn=1.0, min_events=8, degrade=True,
+                 kernel="shap"):
+        self.p99_ms = float(p99_ms)
+        self.latency_budget = float(latency_budget)
+        self.error_budget = float(error_budget)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.shed_burn = float(shed_burn)
+        self.clear_burn = float(clear_burn)
+        self.min_events = int(min_events)
+        self.degrade = bool(degrade)
+        self.kernel = kernel
+
+    def describe(self):
+        return {name: getattr(self, name) for name in self.__slots__}
+
+
+class SLOMonitor:
+    """Feed with ``observe``; poll with ``evaluate`` (the batcher calls
+    it once per dispatched batch — evaluation is O(window) over a few
+    thousand samples, noise next to a dispatch). ``shedding`` is the
+    admission path's single-read gate."""
+
+    def __init__(self, config=None):
+        self.config = config or SLOConfig()
+        self._lock = threading.Lock()
+        self._samples = []  # (ts, latency_ms or None, error) oldest-first
+        self.shedding = False
+        self.burn_fast = 0.0
+        self.burn_slow = 0.0
+        self.worst_burn_fast = 0.0
+        self.worst_burn_slow = 0.0
+        self.breaches = 0
+        self.recoveries = 0
+        self.shed_total = 0
+        self.observed_total = 0
+        self.time_in_degraded_s = 0.0
+        self._degraded_since = None
+        self._took_rung = False
+
+    # -- feed ------------------------------------------------------------
+
+    def observe(self, latency_ms=None, error=False, now=None):
+        """One completed (or failed) request."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self._samples.append((now, latency_ms, bool(error)))
+            self.observed_total += 1
+            self._prune(now)
+
+    def record_shed(self):
+        """One admission rejected because of the shedding state — the
+        accounting behind ``serve_shed_pct`` in the bench detail."""
+        with self._lock:
+            self.shed_total += 1
+        core.counter_add("serve.shed")
+
+    def _prune(self, now):
+        horizon = now - self.config.slow_window_s
+        drop = 0
+        for ts, _, _ in self._samples:
+            if ts >= horizon:
+                break
+            drop += 1
+        if drop:
+            del self._samples[:drop]
+
+    # -- evaluate + actuate ----------------------------------------------
+
+    def _window_burn(self, samples):
+        cfg = self.config
+        n = len(samples)
+        if n < cfg.min_events:
+            return 0.0
+        over = sum(1 for _, lat, _ in samples
+                   if lat is not None and lat > cfg.p99_ms)
+        errors = sum(1 for _, _, err in samples if err)
+        return max((over / n) / cfg.latency_budget,
+                   (errors / n) / cfg.error_budget)
+
+    def evaluate(self, now=None):
+        """Recompute both burns and run the transition machine. Returns
+        the current state dict (what the slo events carry)."""
+        cfg = self.config
+        now = time.time() if now is None else now
+        with self._lock:
+            self._prune(now)
+            slow = list(self._samples)
+            fast_horizon = now - cfg.fast_window_s
+            fast = [s for s in slow if s[0] >= fast_horizon]
+            self.burn_fast = self._window_burn(fast)
+            self.burn_slow = self._window_burn(slow)
+            self.worst_burn_fast = max(self.worst_burn_fast,
+                                       self.burn_fast)
+            self.worst_burn_slow = max(self.worst_burn_slow,
+                                       self.burn_slow)
+            lats = sorted(lat for _, lat, _ in fast if lat is not None)
+            p99 = lats[min(len(lats) - 1, int(len(lats) * 0.99))] \
+                if lats else 0.0
+            err_rate = (sum(1 for _, _, e in fast if e) / len(fast)) \
+                if fast else 0.0
+            breach = (not self.shedding
+                      and self.burn_fast >= cfg.shed_burn
+                      and self.burn_slow >= cfg.shed_burn)
+            recover = self.shedding and self.burn_fast < cfg.clear_burn
+            if breach:
+                self.shedding = True
+                self.breaches += 1
+                self._degraded_since = now
+            elif recover:
+                self.shedding = False
+                self.recoveries += 1
+                if self._degraded_since is not None:
+                    self.time_in_degraded_s += now - self._degraded_since
+                    self._degraded_since = None
+            state = {"burn_fast": round(self.burn_fast, 3),
+                     "burn_slow": round(self.burn_slow, 3),
+                     "p99_ms": round(float(p99), 3),
+                     "error_rate": round(err_rate, 4),
+                     "shed_total": self.shed_total,
+                     "shedding": self.shedding}
+        # Actuation + witness OUTSIDE the lock: the ladder and the sink
+        # take their own locks, and observe() must never wait on them.
+        if breach:
+            degraded = False
+            if cfg.degrade:
+                from flake16_framework_tpu.resilience import ladder
+
+                degraded = ladder.mark_pallas_broken(kernel=cfg.kernel)
+                self._took_rung = self._took_rung or degraded
+            core.event("slo", state="breach", degraded=degraded, **state)
+        elif recover:
+            if self._took_rung:
+                from flake16_framework_tpu.resilience import ladder
+
+                ladder.clear_pallas_broken(kernel=cfg.kernel)
+                self._took_rung = False
+            core.event("slo", state="recovered", **state)
+        return state
+
+    # -- reporting -------------------------------------------------------
+
+    def summary(self, now=None):
+        """The bench/report rollup (BENCH_r10 detail fields)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            degraded_s = self.time_in_degraded_s
+            if self._degraded_since is not None:
+                degraded_s += now - self._degraded_since
+            total = self.observed_total + self.shed_total
+            return {
+                "worst_burn_fast": round(self.worst_burn_fast, 3),
+                "worst_burn_slow": round(self.worst_burn_slow, 3),
+                "breaches": self.breaches,
+                "recoveries": self.recoveries,
+                "shed_total": self.shed_total,
+                "serve_shed_pct": round(100.0 * self.shed_total / total, 3)
+                if total else 0.0,
+                "time_in_degraded_s": round(degraded_s, 3),
+                "shedding": self.shedding,
+                "objective_p99_ms": self.config.p99_ms,
+            }

@@ -34,7 +34,8 @@ from flake16_framework_tpu.obs import report, schema
 # Kinds rendered as point events; everything else schema-known is handled
 # explicitly below.
 _INSTANT_KINDS = ("fault", "heartbeat", "profile", "stage", "cost",
-                  "journal", "drain", "restart")
+                  "journal", "drain", "restart", "metrics", "slo",
+                  "flight")
 
 _PID = 1  # single-process runs: one chrome "process" per run
 
@@ -61,9 +62,16 @@ def chrome_trace(manifest, events):
     tids = {}  # lane key (thread ident or span family) -> small tid
 
     def lane(ev):
-        key = ev.get("tid")
-        if key is None:  # pre-tid logs: lane per span-name family
-            key = str(ev.get("name", "?")).split(".")[0]
+        # Per-request lanes first: spans carrying a trace context render
+        # on a ``request <id>`` lane beside the per-thread lanes, so one
+        # Perfetto view shows a sampled request crossing the batcher.
+        trace_id = ev.get("trace_id")
+        if isinstance(trace_id, str) and trace_id:
+            key = f"request {trace_id[:8]}"
+        else:
+            key = ev.get("tid")
+            if key is None:  # pre-tid logs: lane per span-name family
+                key = str(ev.get("name", "?")).split(".")[0]
         if key not in tids:
             tids[key] = len(tids) + 1
             label = f"thread {key}" if isinstance(key, int) else key

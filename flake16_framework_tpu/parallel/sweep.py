@@ -1149,12 +1149,13 @@ class SweepEngine:
                       stage="plan", batch=len(plan.configs),
                       pad=plan.pad, configs=configs_field):
             t0 = time.time()
-            counts_f = np.asarray(plan_fn(  # np.asarray blocks
-                x, jnp.asarray(self.labels_raw), jnp.asarray(fls),
-                jnp.asarray(preps), jnp.asarray(bals), jnp.asarray(keys),
-                jnp.asarray(trms), jnp.asarray(tems),
-                jnp.asarray(self.project_ids),
-            ))
+            with obs.xprof_trace(f"plan-{model_name.replace(' ', '_')}"):
+                counts_f = np.asarray(plan_fn(  # np.asarray blocks
+                    x, jnp.asarray(self.labels_raw), jnp.asarray(fls),
+                    jnp.asarray(preps), jnp.asarray(bals),
+                    jnp.asarray(keys), jnp.asarray(trms),
+                    jnp.asarray(tems), jnp.asarray(self.project_ids),
+                ))
             wall = (time.time() - t0) / len(plan.configs)
 
         out = []

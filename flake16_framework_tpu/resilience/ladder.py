@@ -132,6 +132,24 @@ def mark_pallas_broken(exc=None, kernel="shap"):
     return True
 
 
+def clear_pallas_broken(kernel="shap"):
+    """Release the pallas->xla rung — the SLO monitor's recovery path
+    (obs/slo.py): a burn-rate breach takes the rung via
+    ``mark_pallas_broken`` to shed kernel latency, and once the burn
+    clears the fast arm is restored. Returns True when the rung was
+    actually set (mirrors ``mark_pallas_broken``'s first-marking True)."""
+    if not pallas_broken(kernel):
+        return False
+    if kernel == "shap":
+        _STATE.pallas_broken = False
+    else:
+        _STATE.pallas_broken_kernels.discard(kernel)
+    obs.event("fault", fault_class=faults.DETERMINISTIC,
+              action="recovered", attempt=0, step="pallas-restored",
+              kernel=kernel)
+    return True
+
+
 def device_context():
     """Context manager pinning dispatches to the host CPU device while the
     ladder is on the cpu-fallback rung; a no-op otherwise (and whenever

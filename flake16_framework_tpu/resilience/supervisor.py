@@ -75,6 +75,7 @@ def supervise(argv, *, max_restarts=3, env=None, cwd=None, backoff_s=0.0,
             return rc, history
         history.append({"rc": rc, "signal": -rc,
                         "wall_s": round(time.time() - t0, 3)})
+        _dump_flight(base_env, warn_out)
         attempt += 1
         if attempt > max_restarts:
             raise RestartBudgetExceeded(
@@ -89,3 +90,22 @@ def supervise(argv, *, max_restarts=3, env=None, cwd=None, backoff_s=0.0,
                 f"{attempt}/{max_restarts} with resume\n")
         if backoff_s:
             time.sleep(backoff_s)
+
+
+def _dump_flight(base_env, warn_out):
+    """Dump the dead child's flight ring (obs/flight.py) before the
+    restart overwrites it: replay the CRC-valid tail, pretty-print it,
+    flush gauge last-values into the child's run manifest. Only possible
+    when F16_FLIGHT names an explicit path the parent can see (the
+    ``=1`` run-dir form is private to the child); never fatal — the
+    restart must proceed whatever the ring looks like."""
+    from flake16_framework_tpu.obs import flight
+
+    path = flight.env_path(environ=base_env)
+    if not path or not os.path.isfile(path):
+        return
+    try:
+        flight.dump(path, out=warn_out)
+    except (OSError, ValueError) as e:
+        if warn_out is not None:
+            warn_out.write(f"supervisor: flight dump failed: {e}\n")

@@ -34,14 +34,17 @@ class Microbatcher:
     :class:`~flake16_framework_tpu.serve.store.ExecutableStore`."""
 
     def __init__(self, store, requests, *, buckets=(8, 32, 128),
-                 max_inflight=2, guard=None, stats=None):
+                 max_inflight=2, guard=None, stats=None, monitor=None):
         self.store = store
         self.requests = requests
         self.buckets = tuple(sorted(int(b) for b in buckets))
         self.max_rows = self.buckets[-1]
         self.guard = guard if guard is not None else _guard.default_guard()
         self.stats = stats
+        self.monitor = monitor  # obs.slo.SLOMonitor (None = no SLO loop)
         self.quarantined = {}
+        self.inflight = 0  # dispatches currently inside _run_batch
+        self._inflight_lock = threading.Lock()
         self._handoff = _stdqueue.Queue(maxsize=int(max_inflight))
         self._stop = threading.Event()
         self._threads = []
@@ -106,9 +109,13 @@ class Microbatcher:
                 if self._stop.is_set():
                     return
                 continue
+            with self._inflight_lock:
+                self.inflight += 1
             try:
                 self._run_batch(batch)
             finally:
+                with self._inflight_lock:
+                    self.inflight -= 1
                 self._handoff.task_done()
 
     # -- dispatch --------------------------------------------------------
@@ -119,20 +126,27 @@ class Microbatcher:
                 return b
         return self.buckets[-1]
 
+    def _fail_batch(self, batch, exc):
+        for r in batch:
+            r._fail(exc)
+        if self.monitor is not None:
+            for _ in batch:
+                self.monitor.observe(error=True)
+            self.monitor.evaluate()
+
     def _run_batch(self, batch):
+        t_start = time.perf_counter()
+        wall_start = time.time()
         req0 = batch[0]
         model = self.store.registry.get(req0.model_id)
         if model is None:
-            exc = ServeError(f"model not registered: {req0.model_id}")
-            for r in batch:
-                r._fail(exc)
+            self._fail_batch(batch, ServeError(
+                f"model not registered: {req0.model_id}"))
             return
         if req0.model_id in self.quarantined:
-            exc = ServeError(
+            self._fail_batch(batch, ServeError(
                 f"model quarantined: {req0.model_id} "
-                f"[{self.quarantined[req0.model_id]['fault_class']}]")
-            for r in batch:
-                r._fail(exc)
+                f"[{self.quarantined[req0.model_id]['fault_class']}]"))
             return
 
         rows = sum(r.n for r in batch)
@@ -147,13 +161,22 @@ class Microbatcher:
             with _ladder.device_context():
                 return self.store.call(model, req0.kind, xpad)
 
+        # Batch fan-in as span links: the coalesced requests' trace ids
+        # ride the dispatch span, joining each sampled request's lane to
+        # the microbatch that actually carried it.
+        links = [r.trace["trace_id"] for r in batch if r.trace]
+        span_fields = {"rows": rows, "bucket": bucket,
+                       "coalesced": len(batch)}
+        if links:
+            span_fields["links"] = links
         try:
             with obs.span("serve.dispatch",
                           key=f"{req0.model_id}/{req0.kind}",
-                          rows=rows, bucket=bucket, coalesced=len(batch)):
-                out = self.guard.call(
-                    thunk, config_index=model.config_index,
-                    label=f"serve:{req0.model_id}:{req0.kind}")
+                          **span_fields):
+                with obs.xprof_trace(f"serve-{req0.kind}"):
+                    out = self.guard.call(
+                        thunk, config_index=model.config_index,
+                        label=f"serve:{req0.model_id}:{req0.kind}")
         except Exception as e:
             if isinstance(e, _guard.DispatchAbandoned):
                 self.quarantined[req0.model_id] = {
@@ -161,8 +184,7 @@ class Microbatcher:
                     "attempts": len(e.attempts),
                     "kind": req0.kind,
                 }
-            for r in batch:
-                r._fail(e)
+            self._fail_batch(batch, e)
             return
 
         host = np.asarray(out)  # f16lint: disable=J601
@@ -171,11 +193,34 @@ class Microbatcher:
         for r in batch:
             r._complete(host[off:off + r.n].copy())
             off += r.n
+            latency_ms = (t_done - r.t_submit) * 1000.0
             if self.stats is not None:
-                self.stats.record((t_done - r.t_submit) * 1000.0)
+                self.stats.record(latency_ms)
+            if self.monitor is not None:
+                self.monitor.observe(latency_ms=latency_ms)
+            if r.trace:
+                # Per-request lanes (trace renderer): the queue leg ends
+                # at dispatch start; the full request leg ends now —
+                # start = ts - wall in both, so the lane reads
+                # submit→dispatch→response without clock gymnastics.
+                obs.event("span", name="serve.request.queue",
+                          wall_s=round(t_start - r.t_submit, 6),
+                          cold=False, ts=round(wall_start, 4),
+                          trace_id=r.trace["trace_id"],
+                          span_id=r.trace["span_id"],
+                          model_id=r.model_id, req_kind=r.kind)
+                obs.event("span", name="serve.request",
+                          wall_s=round(t_done - r.t_submit, 6),
+                          cold=False, trace_id=r.trace["trace_id"],
+                          span_id=r.trace["span_id"],
+                          model_id=r.model_id, req_kind=r.kind, rows=r.n,
+                          coalesced=len(batch))
         obs.counter_add("serve.requests", len(batch))
         obs.gauge("serve.queue_depth", self.requests.depth())
+        obs.gauge("serve.inflight", self.inflight)
         if self.stats is not None:
             snap = self.stats.snapshot()
             obs.gauge("serve.p50_ms", snap["p50_ms"])
             obs.gauge("serve.p99_ms", snap["p99_ms"])
+        if self.monitor is not None:
+            self.monitor.evaluate()

@@ -7,6 +7,13 @@ closed-loop client load, print one JSON stats line.
         [--kinds predict,shap] [--buckets 8,32,128]
         [--registry DIR] [--json]
         [--hold] [--hold-timeout S] [--drain-deadline S]
+        [--metrics-port P] [--slo] [--slo-p99-ms MS]
+
+``--metrics-port P`` stands the Prometheus exporter up on loopback port
+P (0 = ephemeral; the bound port prints as ``METRICS_PORT <p>``), and —
+like ``--slo`` — arms the SLO monitor: declared objectives
+(``--slo-p99-ms``) evaluated as multi-window burn rates that shed load
+at admission and step the degradation ladder on breach (obs/slo.py).
 
 Without ``--ledger`` it fits + registers the study's two SHAP configs
 (config.SHAP_CONFIGS) on synthetic data; with it, every config the
@@ -143,6 +150,7 @@ def _parse(args):
         "kinds": ("predict",), "buckets": (8, 32, 128),
         "registry": None, "json": False,
         "hold": False, "hold_timeout": 120.0, "drain_deadline": 10.0,
+        "metrics_port": None, "slo": False, "slo_p99_ms": 50.0,
     }
     it = iter(args)
     for a in it:
@@ -150,8 +158,12 @@ def _parse(args):
             opts["json"] = True
         elif a == "--hold":
             opts["hold"] = True
-        elif a in ("--hold-timeout", "--drain-deadline"):
+        elif a == "--slo":
+            opts["slo"] = True
+        elif a in ("--hold-timeout", "--drain-deadline", "--slo-p99-ms"):
             opts[a[2:].replace("-", "_")] = float(next(it))
+        elif a == "--metrics-port":
+            opts["metrics_port"] = int(next(it))
         elif a in ("--synth", "--trees", "--max-depth", "--limit",
                    "--requests", "--rows", "--clients"):
             opts[a[2:].replace("-", "_")] = int(next(it))
@@ -193,7 +205,19 @@ def serve_main(args):
                 keys, feats, labels, max_depth=opts["max_depth"],
                 tree_overrides=overrides, persist=persist)
 
-    with ScoringService(registry, buckets=opts["buckets"]) as svc:
+    slo_cfg = None
+    if opts["slo"] or opts["metrics_port"] is not None:
+        # The SLO loop rides along whenever the live plane is up: a
+        # metrics endpoint without burn rates would expose gauges the
+        # admission path ignores — the opposite of ROADMAP item 5.
+        from flake16_framework_tpu.obs.slo import SLOConfig
+
+        slo_cfg = SLOConfig(p99_ms=opts["slo_p99_ms"])
+
+    with ScoringService(registry, buckets=opts["buckets"], slo=slo_cfg,
+                        metrics_port=opts["metrics_port"]) as svc:
+        if svc.metrics is not None:
+            print(f"METRICS_PORT {svc.metrics.port}", flush=True)
         if opts["hold"]:
             result = hold_until_signal(
                 svc, feats, registry.ids(), rows=opts["rows"],
@@ -205,6 +229,9 @@ def serve_main(args):
                 svc, feats, registry.ids(), n_requests=opts["requests"],
                 rows=opts["rows"], kinds=opts["kinds"],
                 clients=opts["clients"])
+        slo_summary = svc.slo_summary()
+        if slo_summary is not None:
+            result["slo"] = slo_summary
 
     import jax
 

@@ -82,15 +82,29 @@ class ScoringService:
     """
 
     def __init__(self, registry, *, buckets=(8, 32, 128), max_inflight=2,
-                 queue_max=256, guard=None, donate=None):
+                 queue_max=256, guard=None, donate=None, slo=None,
+                 metrics_port=None):
         self.registry = registry
         self.buckets = tuple(sorted(int(b) for b in buckets))
         self.store = ExecutableStore(registry, donate=donate)
         self.requests = RequestQueue(maxsize=queue_max)
         self.latency = LatencyStats()
+        # ``slo`` is the declared-objectives config (obs.slo.SLOConfig,
+        # True = defaults, None = no SLO loop — zero new hot-path work).
+        self.slo = None
+        if slo is not None and slo is not False:
+            from flake16_framework_tpu.obs.slo import SLOConfig, SLOMonitor
+
+            self.slo = SLOMonitor(
+                SLOConfig() if slo is True else slo)
         self.batcher = Microbatcher(
             self.store, self.requests, buckets=self.buckets,
-            max_inflight=max_inflight, guard=guard, stats=self.latency)
+            max_inflight=max_inflight, guard=guard, stats=self.latency,
+            monitor=self.slo)
+        # ``metrics_port`` stands the Prometheus exporter up beside the
+        # service (0 = ephemeral; None = off, same contract as the SLO).
+        self.metrics_port = metrics_port
+        self.metrics = None
         self._started = False
 
     # -- lifecycle -------------------------------------------------------
@@ -105,6 +119,9 @@ class ScoringService:
         obs.manifest_update(
             verb="serve", serve_models=len(self.registry),
             serve_buckets=list(self.buckets))
+        if self.metrics_port is not None:
+            self.metrics = self._make_metrics_server(self.metrics_port)
+            self.metrics.start()
         self.batcher.start()
         self._started = True
         return self
@@ -112,7 +129,62 @@ class ScoringService:
     def stop(self):
         self.requests.close()
         self.batcher.stop()
+        if self.metrics is not None:
+            self.metrics.stop()
+            self.metrics = None
         self._started = False
+
+    def _make_metrics_server(self, port):
+        """Registry with the process-wide sources plus this service's
+        live serve/SLO sources, behind a loopback HTTP thread."""
+        from flake16_framework_tpu.obs.metrics import (
+            MetricsRegistry, MetricsServer, register_process_sources,
+        )
+
+        reg = MetricsRegistry()
+        register_process_sources(reg)
+        reg.register("f16_serve_queue_depth", self.requests.depth,
+                     help="Requests queued awaiting coalescing.")
+        reg.register("f16_serve_inflight",
+                     lambda: self.batcher.inflight,
+                     help="Microbatches currently inside a dispatch.")
+        reg.register("f16_serve_quarantined",
+                     lambda: len(self.batcher.quarantined),
+                     help="Models quarantined after abandoned dispatches.")
+        reg.register("f16_serve_requests_total",
+                     lambda: self.latency.snapshot()["count"],
+                     kind="counter",
+                     help="Requests completed since service start.")
+        reg.register("f16_serve_p50_ms",
+                     lambda: self.latency.snapshot()["p50_ms"],
+                     help="p50 request latency over the rolling window, "
+                          "ms.")
+        reg.register("f16_serve_p99_ms",
+                     lambda: self.latency.snapshot()["p99_ms"],
+                     help="p99 request latency over the rolling window, "
+                          "ms.")
+        if self.slo is not None:
+            reg.register("f16_slo_burn_fast",
+                         lambda: self.slo.burn_fast,
+                         help="SLO burn rate over the fast window "
+                              "(1.0 = on budget).")
+            reg.register("f16_slo_burn_slow",
+                         lambda: self.slo.burn_slow,
+                         help="SLO burn rate over the slow window.")
+            reg.register("f16_slo_shedding",
+                         lambda: int(self.slo.shedding),
+                         help="1 while admission is shedding load.")
+            reg.register("f16_serve_shed_total",
+                         lambda: self.slo.shed_total, kind="counter",
+                         help="Admissions rejected by SLO shedding.")
+            reg.register("f16_slo_time_in_degraded_seconds",
+                         lambda: self.slo.summary()["time_in_degraded_s"],
+                         help="Cumulative wall seconds spent shedding.")
+        return MetricsServer(reg, port=port)
+
+    def slo_summary(self):
+        """The SLO rollup for bench/report (None without an SLO loop)."""
+        return self.slo.summary() if self.slo is not None else None
 
     def drain(self, deadline_s=10.0):
         """Graceful drain (see module docstring): close admission, fail
@@ -176,6 +248,14 @@ class ScoringService:
     # -- client API ------------------------------------------------------
 
     def _admit(self, model_id, x, kind):
+        if self.slo is not None and self.slo.shedding:
+            # Bounded-admission rejection: while the burn-rate breach
+            # stands, new work is refused at the door — the queue must
+            # never grow into the latency it is supposed to cure.
+            # Retriable: nothing was queued or dispatched.
+            self.slo.record_shed()
+            raise RetriableRejection(
+                "shedding load (SLO burn-rate breach); retry later")
         if kind not in KINDS:
             raise RequestRejected(f"unknown kind: {kind!r} (want {KINDS})")
         model = self.registry.get(model_id)
@@ -205,9 +285,12 @@ class ScoringService:
         return model, x
 
     def submit(self, model_id, x, kind="predict"):
-        """Admit one request; returns the :class:`ScoreRequest` future."""
+        """Admit one request; returns the :class:`ScoreRequest` future.
+        A trace context is minted here (F16_TRACE_SAMPLE) and rides the
+        request through the batcher to the response."""
         _, x = self._admit(model_id, x, kind)
-        return self.requests.submit(ScoreRequest(model_id, x, kind=kind))
+        return self.requests.submit(
+            ScoreRequest(model_id, x, kind=kind, trace=obs.mint_trace()))
 
     def score(self, model_id, x, kind="predict", timeout=None):
         """Synchronous submit+result."""

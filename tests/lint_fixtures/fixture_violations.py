@@ -55,6 +55,7 @@ def telemetry_drift():
         obs.event("made_up_kind", x=1)             # expect O102
     rec = {"kind": "invented_kind", "ts": 0.0}     # expect O104
     obs.append_jsonl("/tmp/raw.jsonl", rec)
+    obs.gauge("made_up_metric", 1.0)               # expect O105
 
 
 def unguarded_dispatch(x):

@@ -1,0 +1,689 @@
+"""Live observability plane tests (ISSUE 15) — all CPU, tiny models.
+
+The acceptance drills live here: the end-to-end SLO actuation loop
+(injected latency fault -> burn-rate trips -> admission sheds -> ladder
+degrades -> recovery clears, asserted from the emitted ``slo``/``fault``
+events), the flight recorder's crash semantics (ring round-trip, wrap,
+torn-tail replay, gauge flush into the dead run's manifest), the
+Prometheus exporter (registry, exposition validity, the live serve
+endpoint with >= 12 named metrics), per-request trace lanes in the
+Chrome-trace render, and the bench-gate treatment of serve_shed_pct.
+SIGKILL-vs-flight-ring is tools/chaos_drill.py's ``flight`` drill.
+"""
+
+import json
+import os
+import struct
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from flake16_framework_tpu import obs  # noqa: E402
+from flake16_framework_tpu.analysis.engine import Module  # noqa: E402
+from flake16_framework_tpu.analysis import rules_obs  # noqa: E402
+from flake16_framework_tpu.obs import core as obs_core  # noqa: E402
+from flake16_framework_tpu.obs import flight, metrics, schema  # noqa: E402
+from flake16_framework_tpu.obs import report as obs_report  # noqa: E402
+from flake16_framework_tpu.obs import trace as obs_trace  # noqa: E402
+from flake16_framework_tpu.obs.slo import SLOConfig, SLOMonitor  # noqa: E402
+from flake16_framework_tpu.resilience import inject, ladder  # noqa: E402
+from flake16_framework_tpu.serve import (  # noqa: E402
+    ModelRegistry, RetriableRejection, ScoringService,
+)
+from flake16_framework_tpu.utils.synth import make_dataset  # noqa: E402
+
+DT_CONFIG = ("NOD", "Flake16", "None", "None", "Decision Tree")
+MAX_DEPTH = 6
+BUCKETS = (4, 16)
+
+
+@pytest.fixture(autouse=True)
+def _ladder_reset():
+    ladder.reset()
+    yield
+    ladder.reset()
+
+
+@pytest.fixture(scope="module")
+def data():
+    feats, labels, _ = make_dataset(n_tests=160, seed=7)
+    return feats, labels
+
+
+@pytest.fixture(scope="module")
+def registry(data, tmp_path_factory):
+    feats, labels = data
+    root = tmp_path_factory.mktemp("obs-plane-registry")
+    reg = ModelRegistry(str(root))
+    reg.fit_and_register(DT_CONFIG, feats, labels, max_depth=MAX_DEPTH,
+                         seed=3)
+    return reg
+
+
+def _events(run_dir):
+    out = []
+    with open(os.path.join(run_dir, schema.EVENTS_FILE)) as fd:
+        for line in fd:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+# -- flight recorder ------------------------------------------------------
+
+
+def test_flight_round_trip(tmp_path):
+    path = str(tmp_path / "flight.bin")
+    rec = flight.FlightRecorder(path, capacity=4096)
+    evs = [{"kind": "gauge", "name": "serve.queue_depth", "value": i,
+            "ts": 1000.0 + i, "run": "r1"} for i in range(10)]
+    for ev in evs:
+        rec.record(ev)
+    rec.close()
+    records, meta = flight.replay(path)
+    assert records == evs
+    assert meta["n"] == 10 and meta["torn"] is False
+    assert meta["head"] == 0 and meta["tail"] == meta["valid_end"]
+
+
+def test_flight_ring_wraps_keeping_newest(tmp_path):
+    path = str(tmp_path / "flight.bin")
+    rec = flight.FlightRecorder(path, capacity=1024)
+    for i in range(200):
+        rec.record({"kind": "gauge", "name": "serve.queue_depth",
+                    "value": i, "ts": float(i), "run": "r1"})
+    rec.close()
+    records, meta = flight.replay(path)
+    assert meta["torn"] is False
+    assert meta["head"] > 0  # old records fell off the front
+    values = [r["value"] for r in records]
+    assert values == list(range(200 - len(values), 200))  # newest tail
+    assert 0 < len(values) < 200
+
+
+def test_flight_torn_tail_replays_valid_prefix(tmp_path):
+    path = str(tmp_path / "flight.bin")
+    rec = flight.FlightRecorder(path, capacity=4096)
+    for i in range(8):
+        rec.record({"kind": "counter", "name": "folds", "inc": 1,
+                    "total": i, "ts": float(i), "run": "r1"})
+    rec.close()
+    _, meta = flight.replay(path)
+    # corrupt the final byte of the last published record: its CRC fails,
+    # the walk stops, and the first 7 records survive as the valid prefix
+    with open(path, "r+b") as fd:
+        fd.seek(flight.HEADER_SIZE + (meta["tail"] - 1) % meta["capacity"])
+        byte = fd.read(1)
+        fd.seek(-1, os.SEEK_CUR)
+        fd.write(bytes([byte[0] ^ 0xFF]))
+    records, meta2 = flight.replay(path)
+    assert meta2["torn"] is True
+    assert len(records) == 7
+    assert [r["total"] for r in records] == list(range(7))
+
+
+def test_flight_rejects_bad_magic(tmp_path):
+    path = str(tmp_path / "bogus.bin")
+    with open(path, "wb") as fd:
+        fd.write(b"\x00" * 128)
+    with pytest.raises(ValueError, match="magic"):
+        flight.replay(path)
+    with open(path, "wb") as fd:
+        fd.write(b"\x01")
+    with pytest.raises(ValueError, match="header"):
+        flight.replay(path)
+
+
+def test_flight_env_path_contract(tmp_path):
+    assert flight.env_path(environ={}) is None
+    assert flight.env_path(environ={"F16_FLIGHT": ""}) is None
+    assert flight.env_path(environ={"F16_FLIGHT": "1"}) is None  # no run
+    assert flight.env_path(environ={"F16_FLIGHT": "1"},
+                           run_dir="/r") == os.path.join("/r", "flight.bin")
+    assert flight.env_path(
+        environ={"F16_FLIGHT": "/x/f.bin"}) == "/x/f.bin"
+
+
+def test_flight_armed_run_mirrors_events_and_flushes_manifest(
+        tmp_path, monkeypatch):
+    """Satellite (a) + tentpole 4 wiring: with F16_FLIGHT armed, _emit
+    mirrors every event into the ring; flush_gauges_to_manifest merges a
+    replayed ring's gauge last-values into the run manifest."""
+    ring = str(tmp_path / "flight.bin")
+    monkeypatch.setenv("F16_FLIGHT", ring)
+    run_dir = obs.configure(root=str(tmp_path / "telemetry"),
+                            heartbeat_s=0)
+    try:
+        obs.gauge("serve.queue_depth", 3)
+        obs.gauge("serve.p99_ms", 12.5)
+        obs.counter_add("serve.requests", 4)
+    finally:
+        obs.shutdown()
+    events = _events(run_dir)
+    armed = [e for e in events if e.get("kind") == "flight"]
+    assert armed and armed[0]["action"] == "armed"
+    assert armed[0]["path"] == ring
+    for ev in events:
+        assert schema.validate_event(ev) == []
+
+    records, meta = flight.replay(ring)
+    assert meta["torn"] is False
+    # every sink event after arming is mirrored (armed event included)
+    assert [r["kind"] for r in records] == \
+        [e["kind"] for e in events[events.index(armed[0]):]]
+    gauges = flight.last_gauges(records)
+    assert gauges["serve.queue_depth"] == 3
+    assert gauges["serve.p99_ms"] == 12.5
+
+    updated = flight.flush_gauges_to_manifest(
+        records, root=str(tmp_path / "telemetry"))
+    assert updated == [os.path.join(run_dir, schema.MANIFEST_FILE)]
+    manifest = json.load(open(updated[0]))
+    assert manifest["gauges"]["serve.queue_depth"] == 3
+    assert "flight_dump_ts" in manifest
+    assert schema.validate_manifest(manifest) == []
+
+
+def test_gauge_last_values_flushed_into_manifest_on_shutdown(tmp_path):
+    """Satellite (a): the ordinary shutdown/heartbeat path also lands the
+    gauge last-values in the manifest, flight ring or not."""
+    run_dir = obs.configure(root=str(tmp_path), heartbeat_s=0)
+    try:
+        obs.gauge("serve.queue_depth", 7)
+        obs.gauge("serve.queue_depth", 2)  # last value wins
+    finally:
+        obs.shutdown()
+    manifest = json.load(open(os.path.join(run_dir, schema.MANIFEST_FILE)))
+    assert manifest["gauges"]["serve.queue_depth"] == 2
+
+
+def test_flight_dump_pretty_prints_and_banks_json(tmp_path):
+    import io
+
+    path = str(tmp_path / "flight.bin")
+    rec = flight.FlightRecorder(path, capacity=4096)
+    rec.record({"kind": "gauge", "name": "serve.inflight", "value": 1,
+                "ts": time.time(), "run": "r1"})
+    rec.close()
+    out = io.StringIO()
+    records, meta = flight.dump(path, out=out, flush_manifest=False)
+    assert meta["n"] == 1 and records[0]["name"] == "serve.inflight"
+    text = out.getvalue()
+    assert "1 record(s)" in text and "serve.inflight=1" in text
+    banked = json.load(open(path + ".dump.json"))
+    assert banked["meta"]["n"] == 1
+    assert banked["gauges"]["serve.inflight"] == 1
+
+
+def test_report_flight_verb(tmp_path):
+    import io
+
+    path = str(tmp_path / "flight.bin")
+    rec = flight.FlightRecorder(path)
+    rec.record({"kind": "gauge", "name": "host_rss_peak_mb", "value": 64,
+                "ts": time.time(), "run": "r1"})
+    rec.close()
+    out = io.StringIO()
+    res = obs_report.report_main([path, "--flight"], out=out)
+    assert res["meta"]["n"] == 1
+    assert "host_rss_peak_mb=64" in out.getvalue()
+    with pytest.raises(SystemExit):
+        obs_report.report_main(
+            [str(tmp_path / "nope.bin"), "--flight"], out=io.StringIO())
+
+
+# -- metrics registry + exporter ------------------------------------------
+
+
+def test_registry_collect_render_and_validate():
+    reg = metrics.MetricsRegistry()
+    reg.register("f16_test_gauge", lambda: 3.5, help="a test gauge")
+    reg.register("f16_test_counter", lambda: 7, kind="counter")
+    reg.register("f16_test_labeled", lambda: {"a": 1, "b": 2.5})
+    reg.register("f16_test_absent", lambda: None)
+    reg.register("f16_test_raising", lambda: 1 / 0)
+    assert reg.names() == ["f16_test_absent", "f16_test_counter",
+                           "f16_test_gauge", "f16_test_labeled",
+                           "f16_test_raising"]
+    body = reg.render()
+    assert metrics.validate_exposition(body) == []
+    assert "f16_test_gauge 3.5" in body
+    assert "# TYPE f16_test_counter counter" in body
+    assert 'f16_test_labeled{name="a"} 1' in body
+    assert 'f16_test_labeled{name="b"} 2.5' in body
+    assert "f16_test_absent" not in body  # None source skipped, not 0-faked
+    assert "f16_test_raising" not in body
+    assert "# HELP f16_test_gauge a test gauge" in body
+
+
+def test_validate_exposition_rejects_malformed():
+    assert metrics.validate_exposition("") == ["no metrics exposed"]
+    probs = metrics.validate_exposition(
+        "# TYPE f16_x bogus_kind\nf16_x 1\n")
+    assert any("malformed TYPE" in p for p in probs)
+    probs = metrics.validate_exposition(
+        "# TYPE f16_x gauge\nf16_x not_a_number\n")
+    assert any("malformed sample" in p for p in probs)
+    probs = metrics.validate_exposition("f16_orphan 1\n")
+    assert any("precedes its # TYPE" in p for p in probs)
+
+
+def test_metrics_server_serves_and_404s():
+    reg = metrics.MetricsRegistry()
+    reg.register("f16_test_gauge", lambda: 1)
+    with metrics.MetricsServer(reg, port=0) as server:
+        assert server.port > 0
+        url = f"http://127.0.0.1:{server.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == metrics.CONTENT_TYPE
+            body = resp.read().decode()
+        assert "f16_test_gauge 1" in body
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/bogus", timeout=10.0)
+        assert ei.value.code == 404
+
+
+def test_metrics_smoke_tool():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import metrics_smoke
+    finally:
+        sys.path.pop(0)
+    import io
+
+    out = io.StringIO()
+    assert metrics_smoke.main([], out=out) == 0
+    assert "OK" in out.getvalue()
+
+
+def test_serve_metrics_endpoint_live(registry, data, tmp_path):
+    """Acceptance: ``serve --metrics-port`` exposes >= 12 named live
+    metrics in valid Prometheus text while the service scores."""
+    feats, _ = data
+    obs.configure(root=str(tmp_path), heartbeat_s=0)
+    try:
+        svc = ScoringService(registry, buckets=BUCKETS, slo=True,
+                             metrics_port=0)
+        svc.start()
+        try:
+            model_id = registry.ids()[0]
+            for i in range(4):
+                svc.score(model_id, feats[i:i + 2], timeout=60)
+            url = f"http://127.0.0.1:{svc.metrics.port}/metrics"
+            with urllib.request.urlopen(url, timeout=10.0) as resp:
+                body = resp.read().decode()
+        finally:
+            svc.stop()
+    finally:
+        obs.shutdown()
+    assert metrics.validate_exposition(body) == []
+    names = {line.split()[2] for line in body.splitlines()
+             if line.startswith("# TYPE ")}
+    assert len(names) >= 12, sorted(names)
+    for expected in ("f16_serve_queue_depth", "f16_serve_p99_ms",
+                     "f16_serve_requests_total", "f16_slo_burn_fast",
+                     "f16_slo_shedding", "f16_serve_shed_total",
+                     "f16_uptime_seconds", "f16_host_rss_peak_mb",
+                     "f16_ladder_pallas_broken"):
+        assert expected in names, (expected, sorted(names))
+
+
+# -- SLO monitor ----------------------------------------------------------
+
+
+def _feed(mon, t0, n, lat_ms, error=False):
+    for i in range(n):
+        mon.observe(latency_ms=lat_ms, error=error, now=t0 + i * 0.01)
+
+
+def test_slo_burn_math_and_transitions():
+    cfg = SLOConfig(p99_ms=10.0, latency_budget=0.05, error_budget=0.02,
+                    fast_window_s=1.0, slow_window_s=4.0, shed_burn=2.0,
+                    clear_burn=1.0, min_events=4, degrade=True)
+    mon = SLOMonitor(cfg)
+    t0 = 1000.0
+    # below min_events: no evaluation on noise
+    _feed(mon, t0, 3, 50.0)
+    state = mon.evaluate(now=t0 + 0.1)
+    assert state["burn_fast"] == 0.0 and not mon.shedding
+    # every request over-objective: burn = (1.0)/0.05 = 20 in both windows
+    _feed(mon, t0 + 0.1, 8, 50.0)
+    state = mon.evaluate(now=t0 + 0.3)
+    assert state["burn_fast"] == 20.0 and state["burn_slow"] == 20.0
+    assert mon.shedding and mon.breaches == 1
+    assert ladder.state().pallas_broken  # actuated the ladder rung
+    # no double-breach while already shedding
+    mon.evaluate(now=t0 + 0.35)
+    assert mon.breaches == 1
+    # fast window drains past its horizon: burn_fast 0 -> recovery
+    state = mon.evaluate(now=t0 + 2.0)
+    assert not mon.shedding and mon.recoveries == 1
+    assert not ladder.state().pallas_broken  # released its own rung
+    summary = mon.summary(now=t0 + 2.0)
+    assert summary["worst_burn_fast"] == 20.0
+    assert summary["breaches"] == 1 and summary["recoveries"] == 1
+    assert summary["time_in_degraded_s"] > 0
+
+
+def test_slo_error_rate_burns_budget():
+    cfg = SLOConfig(p99_ms=1000.0, error_budget=0.02, fast_window_s=1.0,
+                    slow_window_s=4.0, min_events=4, degrade=False)
+    mon = SLOMonitor(cfg)
+    t0 = 2000.0
+    _feed(mon, t0, 4, 1.0)
+    _feed(mon, t0 + 0.05, 4, None, error=True)
+    state = mon.evaluate(now=t0 + 0.2)
+    # 4/8 errors against a 2% budget: burn 25 — breach on errors alone
+    assert state["burn_fast"] == 25.0 and mon.shedding
+    assert not ladder.state().pallas_broken  # degrade=False: shed only
+
+
+def test_slo_never_releases_a_rung_it_did_not_take():
+    """A rung taken by a real Mosaic fault stays down through an SLO
+    recovery — the monitor only clears what it actuated itself."""
+    ladder.mark_pallas_broken(kernel="shap")  # the "real fault" rung
+    cfg = SLOConfig(p99_ms=10.0, fast_window_s=1.0, slow_window_s=4.0,
+                    min_events=4, degrade=True)
+    mon = SLOMonitor(cfg)
+    t0 = 3000.0
+    _feed(mon, t0, 8, 50.0)
+    mon.evaluate(now=t0 + 0.2)
+    assert mon.shedding and not mon._took_rung  # rung was already down
+    mon.evaluate(now=t0 + 2.0)
+    assert not mon.shedding
+    assert ladder.state().pallas_broken  # the fault's rung survives
+
+
+def test_slo_shed_accounting():
+    mon = SLOMonitor(SLOConfig())
+    mon.observe(latency_ms=1.0, now=1.0)
+    for _ in range(3):
+        mon.record_shed()
+    s = mon.summary(now=2.0)
+    assert s["shed_total"] == 3
+    assert s["serve_shed_pct"] == 75.0  # 3 shed / (1 observed + 3 shed)
+
+
+def test_clear_pallas_broken_contract():
+    assert ladder.clear_pallas_broken() is False  # nothing to release
+    assert ladder.mark_pallas_broken() is True
+    assert ladder.clear_pallas_broken() is True
+    assert not ladder.state().pallas_broken
+
+
+# -- the end-to-end SLO actuation drill (acceptance) ----------------------
+
+
+def test_slo_actuation_drill(registry, data, tmp_path, monkeypatch):
+    """Acceptance: injected latency fault -> burn-rate trips -> admission
+    sheds -> ladder degrades -> recovery clears — the whole loop, then
+    asserted again from the run's ``slo``/``fault`` events alone."""
+    feats, _ = data
+    # every dispatch's first attempt faults transient; the guard retry's
+    # 60 ms backoff IS the injected latency (objective p99 = 5 ms)
+    monkeypatch.setenv(inject.ENV_VAR, "*:1:transient")
+    monkeypatch.setenv("F16_FAULT_BACKOFF_S", "0.06")
+    run_dir = obs.configure(root=str(tmp_path / "telemetry"),
+                            heartbeat_s=0)
+    slo_cfg = SLOConfig(p99_ms=5.0, latency_budget=0.05,
+                        fast_window_s=1.0, slow_window_s=4.0,
+                        shed_burn=2.0, clear_burn=1.0, min_events=4,
+                        degrade=True, kernel="shap")
+    try:
+        svc = ScoringService(registry, buckets=BUCKETS, slo=slo_cfg)
+        svc.start()
+        try:
+            model_id = registry.ids()[0]
+            # 1) drive slow traffic until the burn rate trips
+            deadline = time.time() + 30
+            shed_seen = False
+            while time.time() < deadline and not shed_seen:
+                try:
+                    svc.score(model_id, feats[:2], timeout=60)
+                except RetriableRejection:
+                    shed_seen = True
+                if svc.slo.shedding:
+                    break
+            assert svc.slo.shedding, "burn-rate breach never tripped"
+            assert ladder.state().pallas_broken  # degraded pallas->xla
+            # 2) admission sheds while the breach stands
+            if not shed_seen:
+                with pytest.raises(RetriableRejection):
+                    svc.submit(model_id, feats[:2])
+            assert svc.slo.shed_total >= 1
+            # 3) fault cleared + fast window drained -> recovery
+            monkeypatch.delenv(inject.ENV_VAR)
+            time.sleep(slo_cfg.fast_window_s + 0.3)
+            svc.slo.evaluate()
+            assert not svc.slo.shedding
+            assert not ladder.state().pallas_broken  # rung released
+            out = svc.score(model_id, feats[:3], timeout=60)
+            assert out.shape[0] == 3  # service serves again
+            summary = svc.slo_summary()
+        finally:
+            svc.stop()
+    finally:
+        obs.shutdown()
+
+    assert summary["breaches"] >= 1 and summary["recoveries"] >= 1
+    assert summary["shed_total"] >= 1
+    assert summary["worst_burn_fast"] >= slo_cfg.shed_burn
+    assert summary["time_in_degraded_s"] > 0
+    # the whole loop is reconstructable from the emitted events alone
+    events = _events(run_dir)
+    for ev in events:
+        assert schema.validate_event(ev) == []
+    slo_events = [e for e in events if e["kind"] == "slo"]
+    assert [e["state"] for e in slo_events][:1] == ["breach"]
+    assert "recovered" in [e["state"] for e in slo_events]
+    breach = slo_events[0]
+    assert breach["burn_fast"] >= slo_cfg.shed_burn
+    assert breach["degraded"] is True
+    fault_steps = [e.get("step") for e in events if e["kind"] == "fault"]
+    assert "pallas-to-xla" in fault_steps      # ladder degraded
+    assert "pallas-restored" in fault_steps    # and restored on recovery
+    shed_counters = [e for e in events if e["kind"] == "counter"
+                     and e.get("name") == "serve.shed"]
+    assert shed_counters and shed_counters[-1]["total"] >= 1
+
+
+# -- per-request tracing --------------------------------------------------
+
+
+def test_mint_trace_contract(tmp_path, monkeypatch):
+    assert obs.mint_trace() is None  # telemetry off: no context
+    obs.configure(root=str(tmp_path), heartbeat_s=0)
+    try:
+        ctx = obs.mint_trace()
+        assert set(ctx) == {"trace_id", "span_id"}
+        assert len(ctx["trace_id"]) == 16 and len(ctx["span_id"]) == 8
+        child = obs.mint_trace(parent=ctx)
+        assert child["trace_id"] == ctx["trace_id"]
+        assert child["parent_id"] == ctx["span_id"]
+        assert child["span_id"] != ctx["span_id"]
+        monkeypatch.setenv("F16_TRACE_SAMPLE", "0")
+        assert obs.mint_trace() is None  # sampled out
+        monkeypatch.setenv("F16_TRACE_SAMPLE", "not-a-rate")
+        assert obs.mint_trace() is None  # unparseable = off, never a crash
+    finally:
+        obs.shutdown()
+
+
+def test_trace_renders_request_lanes(registry, data, tmp_path,
+                                     monkeypatch):
+    """Acceptance: a sampled request crossing the batcher renders on its
+    own ``request <id>`` lane next to the per-thread lanes."""
+    feats, _ = data
+    monkeypatch.setenv("F16_TRACE_SAMPLE", "1")
+    run_dir = obs.configure(root=str(tmp_path / "telemetry"),
+                            heartbeat_s=0)
+    try:
+        svc = ScoringService(registry, buckets=BUCKETS)
+        svc.start()
+        try:
+            model_id = registry.ids()[0]
+            for i in range(3):
+                svc.score(model_id, feats[i:i + 2], timeout=60)
+        finally:
+            svc.stop()
+    finally:
+        obs.shutdown()
+    events = _events(run_dir)
+    req_spans = [e for e in events if e.get("kind") == "span"
+                 and e.get("name") == "serve.request"]
+    assert len(req_spans) == 3
+    assert all(e.get("trace_id") for e in req_spans)
+    queue_spans = [e for e in events if e.get("name") ==
+                   "serve.request.queue"]
+    assert {e["trace_id"] for e in queue_spans} == \
+        {e["trace_id"] for e in req_spans}
+    # dispatch spans carry the batch fan-in as links
+    dispatches = [e for e in events if e.get("name") == "serve.dispatch"]
+    linked = [tid for e in dispatches for tid in e.get("links", [])]
+    assert set(linked) == {e["trace_id"] for e in req_spans}
+
+    manifest, evs = obs_report.load_run(run_dir)
+    trace = obs_trace.chrome_trace(manifest, evs)
+    lanes = [e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "thread_name"]
+    request_lanes = [n for n in lanes if n.startswith("request ")]
+    assert len(request_lanes) == len({e["trace_id"] for e in req_spans})
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"
+          and e.get("name") == "serve.request"]
+    assert len(xs) == 3
+
+
+def test_xprof_trace_hook(tmp_path, monkeypatch):
+    monkeypatch.delenv("F16_XPROF", raising=False)
+    monkeypatch.setattr(obs_core, "_xprof_done", set())
+    assert obs.xprof_trace("tag-a").trace_dir is None  # unarmed: no-op
+    monkeypatch.setenv("F16_XPROF", str(tmp_path))
+    armed = obs.xprof_trace("tag-a")
+    assert armed.trace_dir == os.path.join(str(tmp_path), "tag-a")
+    # one capture per (process, tag): the second request is a no-op
+    assert obs.xprof_trace("tag-a").trace_dir is None
+    assert obs.xprof_trace("tag-b").trace_dir is not None
+
+
+# -- wire schema + lint census --------------------------------------------
+
+
+def test_new_event_kinds_validate():
+    good = [
+        {"kind": "metrics", "ts": 1.0, "run": "r", "action": "serve",
+         "port": 9100, "n_metrics": 14},
+        {"kind": "slo", "ts": 1.0, "run": "r", "state": "breach",
+         "burn_fast": 20.0, "burn_slow": 20.0, "p99_ms": 55.0,
+         "error_rate": 0.0, "shed_total": 0, "shedding": True,
+         "degraded": True},
+        {"kind": "flight", "ts": 1.0, "run": "r", "action": "armed",
+         "path": "/x/flight.bin", "capacity": 262144},
+    ]
+    for ev in good:
+        assert schema.validate_event(ev) == [], ev
+    assert schema.validate_event(
+        {"kind": "slo", "ts": 1.0, "run": "r", "state": "breach"}) != []
+
+
+def test_o105_flags_unregistered_metric_name():
+    mod = Module("m.py", src="from flake16_framework_tpu import obs\n"
+                             "obs.gauge('made_up_metric', 1.0)\n"
+                             "obs.counter_add('also_made_up')\n"
+                             "obs.gauge('serve.queue_depth', 1.0)\n")
+    found = [f for f in rules_obs.check_module(mod) if f.rule == "O105"]
+    assert len(found) == 2
+    assert {"made_up_metric", "also_made_up"} == \
+        {f.message.split("'")[1] for f in found}
+
+
+def test_metric_census_covers_every_emitted_name():
+    """Two-way: every obs.gauge/counter_add literal in the package is in
+    METRIC_CENSUS (O105's forward direction, asserted directly so a
+    failure names the metric), and no census entry is emit-less."""
+    import ast
+    import glob
+
+    emitted = set()
+    for path in glob.glob(os.path.join(
+            REPO, "flake16_framework_tpu", "**", "*.py"), recursive=True):
+        tree = ast.parse(open(path).read())
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = node.func.attr if isinstance(node.func, ast.Attribute) \
+                else node.func.id if isinstance(node.func, ast.Name) \
+                else None
+            if fname in ("gauge", "counter_add") and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                emitted.add(node.args[0].value)
+    assert emitted <= metrics.METRIC_CENSUS, \
+        sorted(emitted - metrics.METRIC_CENSUS)
+    assert metrics.METRIC_CENSUS <= emitted, \
+        sorted(metrics.METRIC_CENSUS - emitted)
+
+
+# -- bench gate -----------------------------------------------------------
+
+
+def test_gate_serve_shed_pct_lower_better():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bench_gate
+    finally:
+        sys.path.pop(0)
+    assert "serve_shed_pct" in bench_gate.LOWER_BETTER
+
+    def rec(n, shed_pct):
+        return {"n": n, "parsed": {
+            "metric": "serve_sustained_rps", "value": 100.0,
+            "unit": "req_per_s", "vs_baseline": None,
+            "detail": {"serve_rps": 100.0, "serve_shed_pct": shed_pct,
+                       "backend": "cpu"}}}
+
+    history = [rec(9, 0.0)]
+    # zero-vs-zero shed passes; a sustained-shedding round fails the gate
+    assert bench_gate.gate(rec(10, 0.0), history)["passed"]
+    res = bench_gate.gate(rec(10, 25.0), history)
+    assert not res["passed"]
+    assert any("serve_shed_pct" in f for f in res["failures"])
+    # vacuous against rounds that predate the metric
+    old = {"n": 9, "parsed": {
+        "metric": "serve_sustained_rps", "value": 100.0,
+        "unit": "req_per_s", "vs_baseline": None,
+        "detail": {"serve_rps": 100.0, "backend": "cpu"}}}
+    res = bench_gate.gate(rec(10, 25.0), [old])
+    assert res["passed"]
+    assert any("serve_shed_pct" in n for n in res["notes"])
+
+
+# -- flight ring binary format pin ----------------------------------------
+
+
+def test_flight_header_format_is_pinned(tmp_path):
+    """PROFILE.md documents the binary format; this pins it: 64-byte
+    header, <8sIIQQ fields, <II record framing."""
+    assert flight.HEADER_SIZE == 64
+    assert flight._HEADER.size <= flight.HEADER_SIZE
+    path = str(tmp_path / "flight.bin")
+    rec = flight.FlightRecorder(path, capacity=1024)
+    rec.record({"kind": "gauge", "name": "trees", "value": 1, "ts": 0.0,
+                "run": "r"})
+    rec.close()
+    blob = open(path, "rb").read()
+    magic, version, cap, head, tail = struct.unpack_from("<8sIIQQ", blob)
+    assert magic == b"F16FLT01" and version == 1 and cap == 1024
+    assert head == 0 and tail > 0
+    length, crc = struct.unpack_from("<II", blob, flight.HEADER_SIZE)
+    payload = blob[flight.HEADER_SIZE + 8:flight.HEADER_SIZE + 8 + length]
+    assert json.loads(payload)["name"] == "trees"
+    import zlib
+
+    assert zlib.crc32(payload) == crc

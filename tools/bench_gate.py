@@ -66,9 +66,15 @@ HIGHER_BETTER = ("value", "scores_speedup", "shap_speedup", "serve_rps",
 # arm): the same census for the whole-grid fused explain pass, and the
 # warm interaction-mode wall. Absent from rounds <= r08, hence vacuous
 # against them.
+# serve_shed_pct (round 10+, the ISSUE-15 observability plane): percent
+# of serve requests shed at admission by the SLO burn-rate monitor
+# during the bench load — sustained shedding on the reference workload
+# is an SLO regression. Absent from rounds <= r09, hence vacuous
+# against them.
 LOWER_BETTER = ("t_ours_scores_s", "t_ours_shap_s", "t_ours_fit_s",
                 "serve_p99_ms", "grid_dispatch_count",
-                "shap_dispatch_count", "shap_interact_s")
+                "shap_dispatch_count", "shap_interact_s",
+                "serve_shed_pct")
 
 
 def load_history(repo=REPO):

@@ -4,6 +4,7 @@ ISSUE-12 planner drill) with no human in the loop.
     python tools/chaos_drill.py sweep    # the kill drill
     python tools/chaos_drill.py plan     # SIGKILL inside a family program
     python tools/chaos_drill.py serve    # the drain drill
+    python tools/chaos_drill.py flight   # SIGKILL vs the flight recorder
     python tools/chaos_drill.py          # all; exit 0 iff every drill PASSes
     python tools/chaos_drill.py --json   # machine-readable verdicts
     python tools/chaos_drill.py --keep   # keep scratch dirs (debugging)
@@ -264,14 +265,94 @@ def drill_serve(workdir):
             "checks": checks, "wall_s": round(time.perf_counter() - t0, 2)}
 
 
+FLIGHT_RUNNER_TEMPLATE = """\
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["F16_FLIGHT"] = {ring!r}
+from flake16_framework_tpu import obs
+obs.configure(root={root!r}, heartbeat_s=0)
+print("FLIGHT_READY", flush=True)
+seq = 0
+while True:
+    seq += 1
+    obs.gauge("serve.queue_depth", seq)
+    obs.counter_add("serve.requests")
+"""
+
+
+def drill_flight(workdir):
+    """SIGKILL a process mid-emit and prove the flight ring survives: the
+    CRC'd tail replays as a valid prefix (torn tail tolerated, never
+    fatal), the last gauge values are recoverable, and the manifest flush
+    lands them in the dead run's manifest.json (ISSUE 15)."""
+    from flake16_framework_tpu.obs import flight, schema
+
+    t0 = time.perf_counter()
+    ring = os.path.join(workdir, "flight.bin")
+    root = os.path.join(workdir, "telemetry")
+    runner = os.path.join(workdir, "flight_runner.py")
+    with open(runner, "w") as fd:
+        fd.write(FLIGHT_RUNNER_TEMPLATE.format(
+            repo=REPO, ring=ring, root=root))
+
+    log("flight: spawning emitter, SIGKILL mid-write")
+    err_log = os.path.join(workdir, "flight.err")
+    proc = subprocess.Popen(
+        [sys.executable, runner], cwd=workdir, stdout=subprocess.PIPE,
+        stderr=open(err_log, "w"), text=True)
+    watchdog = threading.Timer(120, proc.kill)
+    watchdog.start()
+    checks = {}
+    try:
+        line = proc.stdout.readline().rstrip("\n")
+        checks["ready_seen"] = line == "FLIGHT_READY"
+        time.sleep(0.4)  # let the emit loop wrap the ring a few times
+        proc.send_signal(signal.SIGKILL)
+        rc = proc.wait(timeout=30)
+    finally:
+        watchdog.cancel()
+        if proc.poll() is None:
+            proc.kill()
+    checks["killed_by_sigkill"] = rc == -signal.SIGKILL
+
+    # The ring must replay from the dead process's mmap with a CRC-valid
+    # prefix; a torn final record is expected and legal, corruption isn't.
+    records, meta = flight.replay(ring)
+    checks["ring_has_records"] = meta["n"] > 0 and len(records) == meta["n"]
+    checks["records_are_events"] = all(
+        isinstance(r, dict) and "kind" in r for r in records)
+    gauges = flight.last_gauges(records)
+    checks["gauge_tail_recovered"] = gauges.get("serve.queue_depth", 0) >= 1
+    seqs = [r["value"] for r in records
+            if r.get("kind") == "gauge"
+            and r.get("name") == "serve.queue_depth"]
+    checks["gauge_seq_monotonic"] = (
+        len(seqs) > 1 and seqs == sorted(seqs))
+
+    # Manifest flush: the recovered last-values land in the dead run's
+    # manifest.json — the crash-forensics satellite.
+    updated = flight.flush_gauges_to_manifest(records, root=root)
+    checks["manifest_updated"] = len(updated) == 1
+    if updated:
+        manifest = json.load(open(updated[0]))
+        checks["manifest_has_gauges"] = (
+            manifest.get("gauges", {}).get("serve.queue_depth", 0) >= 1
+            and "flight_dump_ts" in manifest)
+        checks["manifest_schema_valid"] = (
+            schema.validate_manifest(manifest) == [])
+
+    return {"drill": "flight", "pass": all(checks.values()),
+            "checks": checks, "wall_s": round(time.perf_counter() - t0, 2)}
+
+
 def main(argv=None):
     args = sys.argv[1:] if argv is None else list(argv)
     as_json = "--json" in args
     keep = "--keep" in args
     names = [a for a in args if not a.startswith("--")] or \
-        ["sweep", "plan", "serve"]
+        ["sweep", "plan", "serve", "flight"]
     drills = {"sweep": drill_sweep, "plan": drill_plan,
-              "serve": drill_serve}
+              "serve": drill_serve, "flight": drill_flight}
     unknown = [n for n in names if n not in drills]
     if unknown:
         raise SystemExit(f"chaos_drill: unknown drill(s) {unknown}; "

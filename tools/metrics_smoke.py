@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Metrics-endpoint smoke test (ISSUE 15e) — tier-1 CI arm.
+
+Stands a MetricsRegistry + MetricsServer up on an ephemeral loopback
+port (exactly what ``serve --metrics-port 0`` does, minus the scoring
+service), GETs ``/metrics`` over real HTTP with urllib, and validates
+the response as Prometheus text exposition format (``# TYPE``/``# HELP``
+grammar, sample lines parse, values are floats). Exit 0 iff the body is
+valid and carries at least ``--min-metrics`` samples.
+
+    python tools/metrics_smoke.py [--min-metrics N] [--verbose]
+
+tests/test_obs_plane.py invokes main() in-process, so the smoke is part
+of the tier-1 suite as well as a standalone operator probe.
+"""
+
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flake16_framework_tpu.obs import metrics  # noqa: E402
+
+
+def main(argv=None, out=sys.stdout):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    min_metrics = 3
+    verbose = False
+    it = iter(argv)
+    for a in it:
+        if a == "--min-metrics":
+            min_metrics = int(next(it))
+        elif a == "--verbose":
+            verbose = True
+        else:
+            raise SystemExit(f"unknown option {a!r}")
+
+    registry = metrics.MetricsRegistry()
+    metrics.register_process_sources(registry)
+    with metrics.MetricsServer(registry, port=0) as server:
+        url = f"http://127.0.0.1:{server.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            ctype = resp.headers.get("Content-Type", "")
+            body = resp.read().decode("utf-8")
+        # a 404 must stay a 404 — the exporter serves exactly one path
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/bogus", timeout=10.0)
+            problems = ["/bogus did not 404"]
+        except urllib.error.HTTPError as e:
+            problems = [] if e.code == 404 else [f"/bogus -> {e.code}"]
+
+    if not ctype.startswith("text/plain"):
+        problems.append(f"unexpected Content-Type {ctype!r}")
+    problems += metrics.validate_exposition(body)
+    n_samples = sum(1 for line in body.splitlines()
+                    if line and not line.startswith("#"))
+    if n_samples < min_metrics:
+        problems.append(
+            f"only {n_samples} samples exposed (< {min_metrics})")
+
+    if verbose:
+        out.write(body)
+    if problems:
+        for p in problems:
+            out.write(f"metrics_smoke: {p}\n")
+        out.write(f"metrics_smoke: FAIL ({len(problems)} problem(s))\n")
+        return 1
+    out.write(f"metrics_smoke: OK ({n_samples} samples, valid exposition)\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,9 @@ Chain on recovery (each stage bounded, logged to _scratch/watcher_r03.log):
                                  _scratch/bench_tpu_tuned.json
   7. hw_trace fit shap         — device traces under the same winners for
                                  the PROFILE.md op-level budget
+  8. xprof planner run         — F16_XPROF-armed bounded scores run; one
+                                 jax.profiler session per plan dispatch
+                                 tag lands under _scratch/xprof/
 
 A stage that fails with the tunnel down again returns the watcher to
 polling; a completed chain exits. Liveness check is `ss -tln` — NEVER a
@@ -253,6 +256,25 @@ def persist_bench_json(out, filename):
         fd.write(lines[-1] + "\n")
 
 
+# The xprof stage's child (ISSUE 15): a one-config planner scores run
+# with F16_XPROF armed, so obs.xprof_trace wraps the plan dispatch in a
+# jax.profiler capture session — the on-device op-level profile under
+# $F16_XPROF/plan-<model>, banked without a hand-driven run.
+XPROF_RUNNER = """\
+import os, sys, tempfile
+sys.path.insert(0, {repo!r})
+from flake16_framework_tpu.pipeline import write_scores
+from flake16_framework_tpu.utils.synth import make_tests_json
+work = tempfile.mkdtemp(prefix="f16-xprof-")
+tests = os.path.join(work, "tests.json")
+make_tests_json(tests, n_tests=100, n_projects=3, seed=11)
+write_scores(tests_file=tests, out_file=os.path.join(work, "scores.pkl"),
+             configs=[("NOD", "Flake16", "None", "None", "Decision Tree")],
+             max_depth=8, planner=True)
+print("xprof captured under", os.environ.get("F16_XPROF"))
+""".format(repo=REPO)
+
+
 def chain():
     """The recovery chain. Returns True when it ran to completion."""
     py = sys.executable
@@ -425,6 +447,12 @@ def chain():
             return False
     run_stage("trace", [py, os.path.join(REPO, "tools", "hw_trace.py"),
                         "fit", "shap", "mfu"], 2400, env_extra=tuned or None)
+    # Device-profiler hook drill (ISSUE 15): a bounded planner run with
+    # F16_XPROF armed banks one jax.profiler session per plan tag under
+    # _scratch/xprof/. Evidence, not a gate — a failure never aborts.
+    xprof_env = dict(tuned or {})
+    xprof_env["F16_XPROF"] = os.path.join(REPO, "_scratch", "xprof")
+    run_stage("xprof", [py, "-c", XPROF_RUNNER], 1200, env_extra=xprof_env)
     # LAST, after every other piece of evidence is banked: the full
     # 216-config grid on the real chip under the tune winners. Its ledger
     # checkpoints after every config and is meta-stamped, so a wedge
