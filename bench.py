@@ -37,6 +37,7 @@ sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tests"))
 
 from flake16_framework_tpu import obs  # noqa: E402  (needs REPO on sys.path)
+from flake16_framework_tpu.obs.perfdb import knob_snapshot  # noqa: E402
 from flake16_framework_tpu.resilience import faults  # noqa: E402
 
 N_TESTS = int(os.environ.get("BENCH_N_TESTS", "2000"))
@@ -813,7 +814,10 @@ def _recent_watcher_tpu_line(max_age_s):
 
 
 def main():
-    detail = {}
+    # Every bench record self-describes its knob environment (ISSUE 16
+    # satellite): perfdb rows ingest it as the key's knob snapshot.
+    # Historical rounds predate this field and backfill as knobs: null.
+    detail = {"knobs": knob_snapshot()}
     result, err = None, None
     n, t = N_TESTS, N_TREES
     tag = f"scores_shap_probe_{len(CONFIGS)}cfg_n{n}"
@@ -1140,6 +1144,7 @@ def serve_bench():
             "slo_time_in_degraded_s": slo.get("time_in_degraded_s"),
             "slo_breaches": slo.get("breaches"),
             "backend": jax.default_backend(),
+            "knobs": knob_snapshot(),
         },
     }))
 

@@ -24,6 +24,18 @@ plus extension verbs the reference lacks:
     python -m flake16_framework_tpu trace [RUN_DIR] [--out FILE]
         # convert a telemetry run into Chrome-trace/Perfetto JSON
         # (obs/trace.py; load in chrome://tracing or ui.perfetto.dev)
+    python -m flake16_framework_tpu perf backfill|ingest|diff|sentinel|lookup
+        # the performance observatory (obs/perfdb.py + obs/perf_diff.py):
+        # a persistent CRC'd perf database keyed by (backend, shape,
+        # kernel, knob snapshot). `backfill` ingests the committed
+        # BENCH_r*.json trajectory; `ingest PATH...` adds bench results,
+        # telemetry run dirs, or audit documents; `diff A B` joins two
+        # runs/rounds per kernel/stage and ranks the deltas (--perfetto
+        # exports a trace-verb-compatible view); `sentinel` fits the
+        # whole committed trajectory and flags step-changes with the
+        # round and top contributing stages (tier-1-safe after
+        # `bench --gate`); `lookup BACKEND SHAPE [KERNEL]` prints the
+        # best-known knob row the planner/serve store consult
     python -m flake16_framework_tpu lint [PATHS] [--json] [--baseline F]
         # f16lint: JAX/TPU-hygiene static analysis + config-grid
         # pre-flight (analysis/); exit 1 on unsuppressed findings;
@@ -211,6 +223,10 @@ def main(argv=None):
         from flake16_framework_tpu.obs.trace import trace_main
 
         trace_main(args)
+    elif command == "perf":
+        from flake16_framework_tpu.obs.perf_diff import perf_main
+
+        perf_main(args)
     elif command == "bench":
         # Only the gate lives behind the verb; the measurement harness
         # stays the standalone bench.py (it owns its env/backend setup).

@@ -123,6 +123,9 @@ KNOBS = {
     "F16_TRACE_SAMPLE": ("float", 0.0),
     "F16_XPROF": ("str", None),
     "F16_FLIGHT": ("str", None),
+    # Performance-observatory database path (obs/perfdb.py): a file
+    # path, "" for the _scratch default, "0" disables the consult.
+    "F16_PERFDB": ("str", None),
 }
 
 # The PAPER's grid size — historical reference only. The pre-flight's

@@ -45,6 +45,10 @@ RULES = {r.id: r for r in (
              "gauge/counter emitted at a call site but unregistered in"
              " the metrics census (obs/metrics.py METRIC_CENSUS) —"
              " invisible to the live exporter"),
+    RuleInfo("O106", ERROR,
+             "hardcoded perfdb schema-version literal outside"
+             " obs/schema.py — rows must stamp schema.PERFDB_SCHEMA, or"
+             " a version drift splits the database"),
 )}
 
 # Kinds whose emitters live OUTSIDE the package lint scope (the default
@@ -55,10 +59,24 @@ _EXTERNAL_EMITTERS = frozenset({"stage"})
 
 _SPAN_NAME_RE = re.compile(r"^[a-z0-9_.]+$")
 
+# Any "flake16-perfdb-*" string constant is a perfdb schema-version
+# literal; only obs/schema.py may spell one (the O106 census — the same
+# single-source-of-truth discipline O104 enforces for event kinds).
+_PERFDB_LITERAL_RE = re.compile(r"^flake16-perfdb-")
+
 
 def check_module(mod):
     findings = []
+    in_schema = mod.path.replace(os.sep, "/").endswith("obs/schema.py")
     for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and _PERFDB_LITERAL_RE.match(node.value) \
+                and not in_schema:
+            findings.append(mod.finding(
+                "O106", RULES["O106"].severity, node,
+                f"perfdb schema literal {node.value!r} hardcoded here — "
+                "import schema.PERFDB_SCHEMA (obs/schema.py) so one "
+                "version bump cannot silently split the database"))
         if not isinstance(node, ast.Call):
             continue
         # O105 covers both call forms — obs.gauge("n", ...) and core.py's

@@ -1,0 +1,449 @@
+"""Differential profiling + the trajectory regression sentinel — the
+``perf`` CLI verb (ISSUE 16b/16c).
+
+    python -m flake16_framework_tpu perf backfill [--db PATH]
+    python -m flake16_framework_tpu perf ingest PATH... [--db PATH]
+    python -m flake16_framework_tpu perf diff A B [--json] [--top N]
+        [--perfetto FILE]
+    python -m flake16_framework_tpu perf sentinel [--json] [--strict]
+        [--threshold PCT]
+    python -m flake16_framework_tpu perf lookup BACKEND SHAPE [KERNEL]
+
+``diff`` answers "where did r05 -> r08 go" in one command: A and B are
+bench rounds (``r05``), bench result files, or telemetry run dirs; their
+perfdb rows join per (kernel, metric) and rank by adverse delta —
+per-stage fit walls, per-config walls, dispatch censuses, kernel costs.
+``--perfetto`` renders the joined rows as a ``trace``-verb-compatible
+Chrome-trace file: one lane per run, one X slice per wall metric, so the
+two runs read side-by-side in ui.perfetto.dev.
+
+``sentinel`` fits the WHOLE committed trajectory — not bench_gate.py's
+pairwise check — per (backend, shape, kernel, metric, baseline-tag)
+series: each round compares against the median of the up-to-3 preceding
+rounds and a step beyond ``--threshold`` (default 15%) in the adverse
+direction is flagged with its round, the preceding level, and the top
+contributing per-stage deltas (the r05 -> r07/r08 fit-wall step, 10.7 s
+-> 13.6 s, is the seeded acceptance case — tests/test_perfdb.py).
+Consecutive flagged rounds collapse into one step whose ``settled``
+value is the post-step plateau. Exit is 0 unless ``--strict`` AND a
+series' LATEST round is a fresh step — the after-``bench --gate``
+posture: known history never fails the chain, a new regression does.
+"""
+
+import json
+import os
+import sys
+
+from flake16_framework_tpu.obs import perfdb, schema
+
+# Metric names where HIGHER is better; everything else (walls, p99,
+# dispatch counts, bytes) regresses upward. ``value`` is the bench
+# headline (a speedup multiple).
+_HIGHER_BETTER = ("value", "rps", "gflops")
+
+
+def higher_is_better(metric):
+    return metric in _HIGHER_BETTER or metric.endswith("speedup")
+
+
+# -- run resolution ------------------------------------------------------
+
+
+def resolve_rows(arg, repo_root=None):
+    """(label, rows) for one ``perf diff`` operand: a committed round
+    tag (``r05``), a bench/audit JSON file, or a telemetry run dir."""
+    rounds = perfdb.committed_rounds(repo_root)
+    if arg in rounds:
+        return arg, perfdb.rows_from_path(rounds[arg], round_tag=arg)
+    if os.path.isdir(arg) or os.path.isfile(arg):
+        return os.path.basename(os.path.normpath(arg)), \
+            perfdb.rows_from_path(arg)
+    raise SystemExit(
+        f"perf: {arg!r} is neither a committed bench round "
+        f"({', '.join(sorted(rounds)) or 'none found'}), a result JSON, "
+        "nor a telemetry run dir")
+
+
+# -- differential profiling ---------------------------------------------
+
+
+def diff_rows(rows_a, rows_b):
+    """Join two row sets per (kernel, metric) and rank the deltas,
+    adverse first then by magnitude — the "where did it go" table."""
+    def index(rows):
+        out = {}
+        for r in rows:
+            for m, v in (r.get("metrics") or {}).items():
+                out[(r["kernel"], m)] = float(v)
+        return out
+
+    a, b = index(rows_a), index(rows_b)
+    entries = []
+    for key in sorted(set(a) & set(b)):
+        kernel, metric = key
+        va, vb = a[key], b[key]
+        delta = vb - va
+        pct = (100.0 * delta / va) if va else None
+        adverse = (delta < 0) if higher_is_better(metric) else (delta > 0)
+        entries.append({
+            "kernel": kernel, "metric": metric,
+            "a": round(va, 4), "b": round(vb, 4),
+            "delta": round(delta, 4),
+            "pct": round(pct, 1) if pct is not None else None,
+            "adverse": adverse,
+        })
+    entries.sort(key=lambda e: (not e["adverse"], -abs(e["delta"]),
+                                e["kernel"], e["metric"]))
+    only_a = sorted(set(a) - set(b))
+    only_b = sorted(set(b) - set(a))
+    return {"entries": entries,
+            "only_a": [f"{k}/{m}" for k, m in only_a],
+            "only_b": [f"{k}/{m}" for k, m in only_b]}
+
+
+def diff_trace(label_a, rows_a, label_b, rows_b, joined):
+    """The diff as a ``trace``-verb-compatible Chrome-trace object: one
+    chrome process per run, one X slice per wall metric (slices lay out
+    sequentially — comparative durations, not a timeline), plus one
+    instant per adverse joined delta carrying the numbers."""
+    out = []
+    cursors = {}
+
+    def emit(pid, label, rows):
+        out.append({"ph": "M", "pid": pid, "name": "process_name",
+                    "args": {"name": f"perf diff {label}"}})
+        out.append({"ph": "M", "pid": pid, "tid": 1, "name": "thread_name",
+                    "args": {"name": "walls"}})
+        cursors[pid] = 0.0
+        for r in sorted(rows, key=lambda r: (r["kernel"],)):
+            for m in perfdb.WALL_METRICS:
+                v = (r.get("metrics") or {}).get(m)
+                if not isinstance(v, (int, float)) or v <= 0:
+                    continue
+                out.append({"ph": "X", "pid": pid, "tid": 1,
+                            "ts": cursors[pid], "dur": v * 1e6,
+                            "cat": "perfdiff",
+                            "name": f"{r['kernel']}.{m}",
+                            "args": {"wall_s": v, "run": label,
+                                     "round": r.get("round")}})
+                cursors[pid] += v * 1e6
+
+    emit(1, label_a, rows_a)
+    emit(2, label_b, rows_b)
+    out.append({"ph": "M", "pid": 3, "name": "process_name",
+                "args": {"name": "perf diff deltas"}})
+    ts = 0.0
+    for e in joined["entries"]:
+        if not e["adverse"]:
+            continue
+        out.append({"ph": "i", "pid": 3, "tid": 0, "s": "p", "ts": ts,
+                    "cat": "perfdiff", "name":
+                    f"{e['kernel']}.{e['metric']} {e['delta']:+g}",
+                    "args": e})
+        ts += 1000.0
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "otherData": {"diff": f"{label_a} -> {label_b}",
+                          "schema": schema.PERFDB_SCHEMA}}
+
+
+def render_diff(label_a, label_b, joined, top=20):
+    out = [f"perf diff {label_a} -> {label_b} "
+           f"({len(joined['entries'])} joined rows)"]
+    hdr = (f"{'kernel':<28}{'metric':<12}{label_a:>10}{label_b:>10}"
+           f"{'delta':>10}{'pct':>8}")
+    out += [hdr, "-" * len(hdr)]
+    for e in joined["entries"][:top]:
+        pct = f"{e['pct']:+.1f}%" if e["pct"] is not None else "-"
+        mark = " <-- regressed" if e["adverse"] else ""
+        out.append(f"{e['kernel']:<28}{e['metric']:<12}{e['a']:>10.3f}"
+                   f"{e['b']:>10.3f}{e['delta']:>+10.3f}{pct:>8}{mark}")
+    if len(joined["entries"]) > top:
+        out.append(f"... {len(joined['entries']) - top} more rows")
+    for side, label in (("only_a", label_a), ("only_b", label_b)):
+        if joined[side]:
+            out.append(f"only in {label}: {len(joined[side])} row(s) "
+                       f"({', '.join(joined[side][:6])}"
+                       f"{', ...' if len(joined[side]) > 6 else ''})")
+    return "\n".join(out)
+
+
+# -- the regression sentinel --------------------------------------------
+
+_ROUND_WINDOW = 3  # preceding rounds the step baseline medians over
+
+
+def _round_key(tag):
+    digits = "".join(c for c in str(tag) if c.isdigit())
+    return (int(digits) if digits else 0, str(tag))
+
+
+def build_series(rows):
+    """{(backend, shape, kernel, metric, baseline): {round: value}} —
+    speedup-like metrics keep their baseline comparability tag so r02's
+    numpy-oracle numbers never sit in a C-baseline series (the same
+    split bench_gate.py keys its pairwise check on)."""
+    series = {}
+    for r in rows:
+        rnd = r.get("round")
+        if not rnd:
+            continue
+        for m, v in (r.get("metrics") or {}).items():
+            base = r.get("baseline") if higher_is_better(m) else None
+            key = (r.get("backend"), r.get("shape"), r.get("kernel"),
+                   m, base)
+            series.setdefault(key, {})[rnd] = float(v)
+    return series
+
+
+def _median(vals):
+    vals = sorted(vals)
+    mid = len(vals) // 2
+    return vals[mid] if len(vals) % 2 else \
+        0.5 * (vals[mid - 1] + vals[mid])
+
+
+def detect_steps(points, threshold=0.15):
+    """Step-changes in one {round: value} series: each round against the
+    median of its up-to-_ROUND_WINDOW predecessors; adverse moves beyond
+    ``threshold`` flag, and consecutive flagged rounds collapse into one
+    step (first flagged round named, plateau value as ``settled``)."""
+    rounds = sorted(points, key=_round_key)
+    flags = []
+    for i, rnd in enumerate(rounds):
+        if i == 0:
+            continue
+        prev = rounds[max(0, i - _ROUND_WINDOW):i]
+        base = _median([points[r] for r in prev])
+        if not base:
+            continue
+        rel = (points[rnd] - base) / abs(base)
+        flags.append((rnd, rounds[i - 1], base, rel))
+    steps = []
+    for rnd, prev_rnd, base, rel in flags:
+        if abs(rel) < threshold:
+            if steps and steps[-1]["open"]:
+                steps[-1]["open"] = False
+            continue
+        adverse = rel > 0
+        if steps and steps[-1]["open"] and \
+                steps[-1]["adverse"] == adverse:
+            steps[-1]["settled_round"] = rnd  # plateau continues
+            steps[-1]["settled"] = points[rnd]
+            continue
+        if steps and steps[-1]["open"]:
+            steps[-1]["open"] = False
+        steps.append({
+            "round": rnd, "prev_round": prev_rnd,
+            "prev": points[prev_rnd], "base": round(base, 4),
+            "value": points[rnd], "settled_round": rnd,
+            "settled": points[rnd], "pct": round(100.0 * rel, 1),
+            "adverse": adverse, "open": True,
+        })
+    for s in steps:
+        s.pop("open", None)
+    return steps, rounds
+
+
+def sentinel(rows=None, path=None, threshold=0.15, repo_root=None,
+             top_stages=3):
+    """The trajectory sweep: perfdb rows (the database, topped up
+    in-memory with any committed round it lacks) -> per-series steps,
+    adverse steps first, each carrying its top contributing per-stage
+    deltas (a diff of the flagged round against its predecessor)."""
+    if rows is None:
+        rows = perfdb.load(path)
+    have = {r.get("round") for r in rows if r.get("round")}
+    rounds = perfdb.committed_rounds(repo_root)
+    for tag, p in rounds.items():
+        if tag not in have:
+            rows = rows + perfdb.rows_from_path(p, round_tag=tag)
+
+    by_round = {}
+    for r in rows:
+        if r.get("round"):
+            by_round.setdefault(r["round"], []).append(r)
+
+    flagged = []
+    n_series = 0
+    latest_adverse = []
+    for key, points in sorted(build_series(rows).items(),
+                              key=lambda kv: kv[0][:4]):
+        if len(points) < 2:
+            continue
+        n_series += 1
+        backend, shape, kernel, metric, baseline = key
+        polarity = -1.0 if higher_is_better(metric) else 1.0
+        signed = {r: polarity * v for r, v in points.items()}
+        steps, series_rounds = detect_steps(signed, threshold=threshold)
+        for s in steps:
+            for f in ("prev", "base", "value", "settled"):
+                s[f] = round(polarity * s[f], 4)
+            s["pct"] = round(polarity * s["pct"], 1)
+            s.update(backend=backend, shape=shape, kernel=kernel,
+                     metric=metric, baseline=baseline)
+            if s["adverse"]:
+                s["stages"] = _top_stage_deltas(
+                    by_round.get(s["prev_round"], ()),
+                    by_round.get(s["round"], ()), top_stages)
+                # fresh = the step OPENED at the trajectory head; a
+                # step still drifting from an earlier round is known
+                # history, not a post-gate failure
+                if s["round"] == series_rounds[-1]:
+                    latest_adverse.append(s)
+            flagged.append(s)
+    flagged.sort(key=lambda s: (not s["adverse"], -abs(s["pct"])))
+    return {"schema": schema.PERFDB_SCHEMA + "+sentinel",
+            "threshold_pct": round(100.0 * threshold, 1),
+            "n_series": n_series,
+            "steps": flagged,
+            "latest_regressions": latest_adverse}
+
+
+def _top_stage_deltas(rows_prev, rows_now, top):
+    """The top contributing wall deltas between a step's two rounds —
+    which stage/config ate the difference."""
+    if not rows_prev or not rows_now:
+        return []
+    joined = diff_rows(rows_prev, rows_now)
+    out = []
+    for e in joined["entries"]:
+        if not e["adverse"] or e["metric"] not in perfdb.WALL_METRICS:
+            continue
+        out.append({"kernel": e["kernel"], "metric": e["metric"],
+                    "delta_s": e["delta"], "pct": e["pct"]})
+        if len(out) >= top:
+            break
+    return out
+
+
+def render_sentinel(result):
+    steps = result["steps"]
+    adverse = [s for s in steps if s["adverse"]]
+    out = [f"perf sentinel: {result['n_series']} series, "
+           f"{len(adverse)} regression step(s), "
+           f"{len(steps) - len(adverse)} improvement step(s) "
+           f"(threshold {result['threshold_pct']}%)"]
+    for s in steps:
+        arrow = "REGRESSED" if s["adverse"] else "improved"
+        tail = "" if s["settled_round"] == s["round"] else \
+            f", settled {s['settled']:g} by {s['settled_round']}"
+        out.append(
+            f"  {s['kernel']}/{s['metric']} [{s['backend']}/{s['shape']}]"
+            f" {arrow} at {s['round']}: {s['prev']:g} ({s['prev_round']})"
+            f" -> {s['value']:g} ({s['pct']:+.1f}% vs recent median"
+            f"{tail})")
+        for st in s.get("stages") or ():
+            out.append(f"      {st['kernel']}.{st['metric']} "
+                       f"{st['delta_s']:+g}s")
+    if result["latest_regressions"]:
+        names = ", ".join(f"{s['kernel']}/{s['metric']}@{s['round']}"
+                          for s in result["latest_regressions"])
+        out.append(f"  LATEST ROUND REGRESSED: {names}")
+    return "\n".join(out)
+
+
+# -- CLI -----------------------------------------------------------------
+
+
+def perf_main(args, out=None):
+    """CLI entry for the ``perf`` verb (``__main__.py``). Returns the
+    subcommand's result object; raises SystemExit on strict failures."""
+    out = out or sys.stdout
+    if not args:
+        raise ValueError(
+            "perf needs a subcommand: backfill | ingest | diff | "
+            "sentinel | lookup")
+    sub, *rest = args
+    as_json = "--json" in rest
+    rest = [a for a in rest if a != "--json"]
+
+    def opt(name, default=None, cast=str):
+        if name in rest:
+            i = rest.index(name)
+            rest.pop(i)
+            if i >= len(rest):
+                raise ValueError(f"{name} needs an argument")
+            return cast(rest.pop(i))
+        return default
+
+    db = opt("--db")
+
+    if sub == "backfill":
+        if rest:
+            raise ValueError(f"Unrecognized perf backfill args {rest!r}")
+        res = perfdb.backfill(path=db)
+        payload = {"rounds": res, "new_rows": sum(res.values()),
+                   "db": perfdb.default_db(db)}
+        out.write(json.dumps(payload) + "\n" if as_json else
+                  f"perf backfill: {payload['new_rows']} new row(s) from "
+                  f"{len(res)} round(s) -> {payload['db']}\n")
+        return payload
+    if sub == "ingest":
+        round_tag = opt("--round")
+        if not rest:
+            raise ValueError("perf ingest needs at least one PATH")
+        total = 0
+        for p in rest:
+            total += perfdb.append(
+                perfdb.rows_from_path(p, round_tag=round_tag), path=db)
+        payload = {"new_rows": total, "paths": rest,
+                   "db": perfdb.default_db(db)}
+        out.write(json.dumps(payload) + "\n" if as_json else
+                  f"perf ingest: {total} new row(s) from "
+                  f"{len(rest)} source(s) -> {payload['db']}\n")
+        return payload
+    if sub == "diff":
+        top = opt("--top", 20, int)
+        perfetto = opt("--perfetto")
+        if len(rest) != 2:
+            raise ValueError("perf diff needs exactly two runs "
+                             "(bench rounds, result files, or run dirs)")
+        label_a, rows_a = resolve_rows(rest[0])
+        label_b, rows_b = resolve_rows(rest[1])
+        joined = diff_rows(rows_a, rows_b)
+        if perfetto:
+            trace = diff_trace(label_a, rows_a, label_b, rows_b, joined)
+            from flake16_framework_tpu.utils.atomic import atomic_write
+
+            with atomic_write(perfetto, "w") as fd:
+                json.dump(trace, fd)
+        payload = {"a": label_a, "b": label_b, **joined}
+        if as_json:
+            out.write(json.dumps(payload, indent=1) + "\n")
+        else:
+            out.write(render_diff(label_a, label_b, joined, top=top)
+                      + "\n")
+            if perfetto:
+                out.write(f"wrote {perfetto} — load in chrome://tracing "
+                          "or https://ui.perfetto.dev\n")
+        return payload
+    if sub == "sentinel":
+        threshold = opt("--threshold", 15.0, float) / 100.0
+        strict = "--strict" in rest
+        rest = [a for a in rest if a != "--strict"]
+        if rest:
+            raise ValueError(f"Unrecognized perf sentinel args {rest!r}")
+        result = sentinel(path=db, threshold=threshold)
+        out.write(json.dumps(result, indent=1) + "\n" if as_json
+                  else render_sentinel(result) + "\n")
+        if strict and result["latest_regressions"]:
+            raise SystemExit(1)
+        return result
+    if sub == "lookup":
+        if len(rest) not in (2, 3):
+            raise ValueError("perf lookup needs BACKEND SHAPE [KERNEL]")
+        row = perfdb.lookup(rest[0], rest[1],
+                            kernel=rest[2] if len(rest) == 3 else None,
+                            path=db)
+        if as_json:
+            out.write(json.dumps(row) + "\n")
+        elif row is None:
+            out.write("perf lookup: no knob-carrying row — callers fall "
+                      "through to current defaults\n")
+        else:
+            out.write(f"perf lookup: {row['kernel']} from {row['src']} "
+                      f"(round {row.get('round')}): "
+                      f"knobs={json.dumps(row['knobs'])} "
+                      f"metrics={json.dumps(row['metrics'])}\n")
+        return row
+    raise ValueError(f"Unrecognized perf subcommand {sub!r}")

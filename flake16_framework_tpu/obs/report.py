@@ -259,13 +259,15 @@ def summarize_attrib(manifest, events):
         st["total_s"] = round(sum(st.values()), 4)
         for name in list(st):
             st[name] = round(st[name], 4)
-    ranked = sorted(configs, key=lambda c: -configs[c]["total_s"])
+    # Deterministic ranking: equal walls tie-break by config code, then
+    # stage name — dict-iteration order must never decide the table.
+    ranked = sorted(configs, key=lambda c: (-configs[c]["total_s"], c))
     return {
         "schema": schema.REPORT_SCHEMA + "+attrib",
         "run": manifest.get("run", "?"),
         "configs": {c: configs[c] for c in ranked},
         "stages": {s: round(w, 4) for s, w in
-                   sorted(stages.items(), key=lambda kv: -kv[1])},
+                   sorted(stages.items(), key=lambda kv: (-kv[1], kv[0]))},
         "kernel_costs": kernels,
     }
 
@@ -295,7 +297,8 @@ def render_attrib(attrib, top=15):
         hdr = (f"{'kernel':<26}{'compiles':>9}{'gflops':>10}{'gbytes':>10}"
                f"{'compile_s':>11}{'cache h/m':>11}")
         out += [hdr, "-" * len(hdr)]
-        for name in sorted(kernels, key=lambda k: -kernels[k]["flops"]):
+        for name in sorted(kernels, key=lambda k: (-kernels[k]["flops"],
+                                                   k)):
             k = kernels[name]
             out.append(
                 f"{name:<26}{k['n']:>9}{k['flops'] / 1e9:>10.3f}"

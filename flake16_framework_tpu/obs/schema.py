@@ -32,6 +32,11 @@ LINT_SCHEMA = "flake16-lint-report-v1"
 # IR-level findings plus the dispatch-census reconciliation and the
 # per-plan memory-envelope table.
 AUDIT_SCHEMA = "flake16-audit-report-v1"
+# The performance-observatory row (obs/perfdb.py): one CRC'd JSONL line
+# per (backend, shape-signature, kernel/stage, knob-snapshot digest)
+# observation. The ONLY place this literal may appear in the package —
+# rows must stamp the constant (O106 guards against a drifted copy).
+PERFDB_SCHEMA = "flake16-perfdb-v1"
 
 _NUM = (int, float)
 
@@ -95,6 +100,11 @@ EVENT_FIELDS = {
     # armed | dump. Armed carries ``path``/``capacity``; dump carries
     # ``path``/``n`` (replayed records) and ``torn``.
     "flight": {"action": str},
+    # Performance-observatory lifecycle (obs/perfdb.py): ``action`` is
+    # append | truncate | backfill. Append carries ``n`` (rows written)
+    # and ``path``; truncate carries the byte ``offset`` of the torn
+    # tail it cut; backfill carries ``n``/``rounds``.
+    "perf": {"action": str},
 }
 
 MANIFEST_FIELDS = {
@@ -215,6 +225,41 @@ def validate_audit_report(obj):
         if missing:
             problems.append(
                 f"audit report: envelopes[{i}] missing {sorted(missing)}")
+    return problems
+
+
+# One perf-database row (obs/perfdb.py). The key quadruple is
+# (backend, shape, kernel, ksig): ``shape`` is the shape-signature
+# string (PROFILE.md "Performance observatory" key grammar), ``kernel``
+# names the kernel/stage the metrics time, ``ksig`` digests the knob
+# snapshot (``"null"`` when ``knobs`` is null — historical rounds).
+# ``metrics`` maps metric name -> number; ``crc`` seals the row.
+PERFDB_ROW_FIELDS = {"schema": str, "backend": str, "shape": str,
+                     "kernel": str, "ksig": str, "metrics": dict,
+                     "src": str, "crc": str}
+
+
+def validate_perfdb_row(obj):
+    """Problems with one perfdb JSONL row (empty list = valid). CRC
+    verification is the store's job (obs/perfdb.load) — this checks the
+    declared shape only, so torn-tail recovery stays a storage concern."""
+    problems = []
+    if not isinstance(obj, dict):
+        return [f"perfdb row is {type(obj).__name__}, want object"]
+    _check_fields(obj, PERFDB_ROW_FIELDS, problems, "perfdb row")
+    if obj.get("schema") != PERFDB_SCHEMA:
+        problems.append(
+            f"perfdb row: schema {obj.get('schema')!r} != "
+            f"{PERFDB_SCHEMA!r}")
+    knobs = obj.get("knobs")
+    if knobs is not None and not isinstance(knobs, dict):
+        problems.append(
+            f"perfdb row: field 'knobs' has type "
+            f"{type(knobs).__name__}, want dict or null")
+    for name, v in (obj.get("metrics") or {}).items():
+        if not isinstance(v, _NUM):
+            problems.append(
+                f"perfdb row: metrics[{name!r}] is not numeric")
     return problems
 
 

@@ -35,7 +35,7 @@ from flake16_framework_tpu.obs import report, schema
 # explicitly below.
 _INSTANT_KINDS = ("fault", "heartbeat", "profile", "stage", "cost",
                   "journal", "drain", "restart", "metrics", "slo",
-                  "flight")
+                  "flight", "perf")
 
 _PID = 1  # single-process runs: one chrome "process" per run
 

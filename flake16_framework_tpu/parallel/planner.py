@@ -96,12 +96,23 @@ def plan_shape(fs_name, model_name, *, n, n_folds, tree_overrides=None):
             int(n_folds), 2 * int(n))
 
 
-def plan_grid(configs, *, devices=1, n, n_folds, tree_overrides=None):
+def plan_grid(configs, *, devices=1, n, n_folds, tree_overrides=None,
+              perf_lookup=None):
     """Group ``configs`` into Plans: one per (family, shape signature),
     members in canonical grid order, padded to a multiple of ``devices``.
     Order-independent: any permutation of ``configs`` yields identical
     plans. Configs outside the canonical grid are a caller bug and raise
-    (their RNG index — hence their results — would be undefined)."""
+    (their RNG index — hence their results — would be undefined).
+
+    ``perf_lookup`` is the performance observatory's consult hook
+    (obs/perfdb.plan_lookup, ISSUE 16d — injected as a callable so this
+    module stays jax- and obs-free): shape tuple -> recorded knob dict.
+    A recorded ``plan_pad_to`` that is a positive multiple of
+    ``devices`` overrides the pad width — result-neutral by the Plan
+    contract (pad slots repeat the first member and are masked out on
+    the host), so a tuned batch alignment can never change scores.
+    Anything else — no database, no row, no knob, an invalid value —
+    falls through to ``devices`` bit-identically."""
     index_of = canonical_indices()
     seen = set()
     members = []
@@ -125,11 +136,26 @@ def plan_grid(configs, *, devices=1, n, n_folds, tree_overrides=None):
         groups.setdefault((family, shape), []).append(keys)
     plans = [
         Plan(family, group, [index_of[k] for k in group], shape,
-             pad_to=devices)
+             pad_to=_pad_to(shape, devices, perf_lookup))
         for (family, shape), group in groups.items()
     ]
     plans.sort(key=lambda p: p.indices[0])
     return plans
+
+
+def _pad_to(shape, devices, perf_lookup):
+    """The pad width for one plan shape: a recorded ``plan_pad_to`` when
+    it is a positive multiple of ``devices``, else ``devices``."""
+    if perf_lookup is None:
+        return devices
+    try:
+        knobs = perf_lookup(shape) or {}
+        pad = int(knobs.get("plan_pad_to"))
+    except (TypeError, ValueError):
+        return devices
+    if pad > 0 and pad % max(1, int(devices)) == 0:
+        return pad
+    return devices
 
 
 def explain_shape(fs_name, model_name, *, n, n_folds, n_explain,

@@ -1465,12 +1465,20 @@ class SweepEngine:
                     done += 1
                     if progress is not None:
                         progress(done, total, keys, scores)
+        # Performance-observatory consult (obs/perfdb.py, ISSUE 16d):
+        # recorded best-known plan padding applies at plan time; an
+        # absent/disabled database returns None and the planner path is
+        # byte-for-byte today's (padding is result-neutral either way —
+        # pad slots are masked out on the host).
+        from flake16_framework_tpu.obs import perfdb
+
         plans = planner.plan_grid(
             rest,
             devices=(self.mesh.devices.size if self.mesh is not None
                      else 1),
             n=self.features.shape[0], n_folds=self.n_folds,
-            tree_overrides=self.tree_overrides)
+            tree_overrides=self.tree_overrides,
+            perf_lookup=perfdb.plan_lookup(jax.default_backend()))
         _preflight_plan_budget(
             plans, n_projects=len(self.project_names),
             max_depth=self.max_depth, grower=self.grower)

@@ -147,7 +147,10 @@ def _parse(args):
     opts = {
         "synth": 512, "trees": 16, "max_depth": 12, "ledger": None,
         "limit": None, "requests": 256, "rows": 16, "clients": 8,
-        "kinds": ("predict",), "buckets": (8, 32, 128),
+        # None = consult the performance observatory for a recorded
+        # bucket ladder, falling through to service.DEFAULT_BUCKETS
+        # (obs/perfdb.serve_buckets); --buckets pins it explicitly.
+        "kinds": ("predict",), "buckets": None,
         "registry": None, "json": False,
         "hold": False, "hold_timeout": 120.0, "drain_deadline": 10.0,
         "metrics_port": None, "slo": False, "slo_p99_ms": 50.0,

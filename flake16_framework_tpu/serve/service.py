@@ -37,6 +37,27 @@ from flake16_framework_tpu.serve.store import (
 )
 
 
+# The bucket ladder every serve entry point warms when nothing better is
+# known — the fall-through side of the perfdb consult below.
+DEFAULT_BUCKETS = (8, 32, 128)
+
+
+def resolve_buckets(buckets=None):
+    """The warm-bucket ladder for a service: an explicit ``buckets``
+    wins untouched; ``None`` consults the performance observatory
+    (obs/perfdb.serve_buckets, ISSUE 16d) for a recorded best-known
+    ladder and falls through to DEFAULT_BUCKETS bit-identically when the
+    database, the row, or a valid ``serve_buckets`` knob is absent."""
+    if buckets is not None:
+        return tuple(sorted(int(b) for b in buckets))
+    from flake16_framework_tpu.obs import perfdb
+
+    recorded = perfdb.serve_buckets()
+    if recorded:
+        return tuple(sorted(int(b) for b in recorded))
+    return DEFAULT_BUCKETS
+
+
 class LatencyStats:
     """Thread-safe bounded ring of request latencies (ms) with p50/p99
     snapshots — the service's SLO instrument."""
@@ -81,11 +102,11 @@ class ScoringService:
     abandoned re-raises from ``result()`` as DispatchAbandoned.
     """
 
-    def __init__(self, registry, *, buckets=(8, 32, 128), max_inflight=2,
+    def __init__(self, registry, *, buckets=None, max_inflight=2,
                  queue_max=256, guard=None, donate=None, slo=None,
                  metrics_port=None):
         self.registry = registry
-        self.buckets = tuple(sorted(int(b) for b in buckets))
+        self.buckets = resolve_buckets(buckets)
         self.store = ExecutableStore(registry, donate=donate)
         self.requests = RequestQueue(maxsize=queue_max)
         self.latency = LatencyStats()

@@ -58,6 +58,10 @@ def telemetry_drift():
     obs.gauge("made_up_metric", 1.0)               # expect O105
 
 
+def perfdb_schema_drift():
+    return {"schema": "flake16-perfdb-v0"}           # expect O106
+
+
 def unguarded_dispatch(x):
     try:
         return jax.block_until_ready(jnp.sum(x))

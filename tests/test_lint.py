@@ -33,7 +33,7 @@ from flake16_framework_tpu.obs import schema  # noqa: E402
 EXPECTED_FIXTURE_RULES = {
     "J101", "J102", "J103", "J104", "J201", "J202", "J203", "J301",
     "J401", "J402", "J501", "J601", "J701", "G107", "O102", "O103",
-    "O104", "O105",
+    "O104", "O105", "O106",
 }
 
 

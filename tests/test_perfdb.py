@@ -1,0 +1,366 @@
+"""Performance observatory (ISSUE 16): perfdb backfill round-trip over
+the committed BENCH trajectory, CRC torn-tail recovery, ``perf diff
+r05 r08`` ranking the fit-wall delta, the sentinel naming the committed
+r05->r07/r08 fit-wall step, and the planner/serve lookup consults —
+recorded knobs applied, absent entries falling through bit-identically."""
+
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from flake16_framework_tpu.obs import perf_diff, perfdb, report, schema
+from flake16_framework_tpu.parallel import planner
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DT_CONFIGS = [
+    ("NOD", "Flake16", "None", "None", "Decision Tree"),
+    ("OD", "Flake16", "Scaling", "None", "Decision Tree"),
+]
+
+TREE_OVERRIDES = {"Extra Trees": 4, "Random Forest": 4}
+
+
+@pytest.fixture(scope="module")
+def committed_db(tmp_path_factory):
+    """One backfill of every committed BENCH_r*.json round."""
+    db = str(tmp_path_factory.mktemp("perfdb") / "perfdb.jsonl")
+    rounds = perfdb.backfill(path=db)
+    return db, rounds
+
+
+# -- store: backfill, CRC, recovery ------------------------------------------
+
+
+def test_backfill_covers_all_committed_rounds(committed_db):
+    db, rounds = committed_db
+    assert set(rounds) >= {f"r{i:02d}" for i in range(1, 10)}
+    assert all(n > 0 for n in rounds.values())
+    rows = perfdb.load(db)
+    assert len(rows) == sum(rounds.values())
+    # every row schema-valid and identity-unique (the dedupe key)
+    for row in rows:
+        assert schema.validate_perfdb_row(row) == []
+    idents = [perfdb.row_identity(r) for r in rows]
+    assert len(idents) == len(set(idents))
+
+
+def test_backfill_idempotent(committed_db):
+    db, _ = committed_db
+    n_before = len(perfdb.load(db))
+    again = perfdb.backfill(path=db)
+    assert sum(again.values()) == 0
+    assert len(perfdb.load(db)) == n_before
+
+
+def test_historical_rounds_backfill_null_knobs(committed_db):
+    # Satellite 16a: rounds benched before the knob snapshot existed
+    # ingest with knobs: null — lookup must never consult them.
+    db, _ = committed_db
+    rows = [r for r in perfdb.load(db) if r["src"].startswith("BENCH_r")]
+    assert rows and all(r["knobs"] is None for r in rows)
+    assert perfdb.lookup("cpu", rows[0]["shape"], path=db) is None
+
+
+def test_torn_tail_recovery(tmp_path):
+    db = str(tmp_path / "perfdb.jsonl")
+    rows = [perfdb.make_row("cpu", "t", f"k{i}", {"wall_s": float(i + 1)},
+                            src=f"s{i}") for i in range(3)]
+    assert perfdb.append(rows, path=db) == 3
+    with open(db, "ab") as fd:
+        fd.write(b'{"schema": "flake16-perfdb-v1", "torn mid-wri')
+    n_rows, n_cut = perfdb.recover(db)
+    assert n_rows == 3 and n_cut > 0
+    assert len(perfdb.load(db)) == 3
+    # a tampered row (CRC mismatch) is skipped by the read plane
+    bad = dict(rows[0], src="tampered")  # stale crc
+    with open(db, "a") as fd:
+        fd.write(json.dumps(bad) + "\n")
+    assert len(perfdb.load(db)) == 3
+
+
+def test_row_validation_catches_drift():
+    row = perfdb.make_row("cpu", "t", "k", {"wall_s": 1.0})
+    assert schema.validate_perfdb_row(row) == []
+    assert schema.validate_perfdb_row(dict(row, schema="flake16-perfdb-v0"))
+    assert schema.validate_perfdb_row(dict(row, knobs=[1, 2]))
+    assert schema.validate_perfdb_row(dict(row, metrics={"wall_s": "x"}))
+
+
+def test_perf_event_kind_declared():
+    # O104 census: the store's telemetry events use a declared kind
+    assert schema.EVENT_FIELDS["perf"] == {"action": str}
+
+
+# -- differential profiling ---------------------------------------------------
+
+
+def test_diff_r05_r08_ranks_fit_wall_regression():
+    _, rows_a = perf_diff.resolve_rows("r05")
+    _, rows_b = perf_diff.resolve_rows("r08")
+    joined = perf_diff.diff_rows(rows_a, rows_b)
+    fit = [e for e in joined["entries"]
+           if e["kernel"] == "fit" and e["metric"] == "wall_s"]
+    assert fit and fit[0]["adverse"]
+    assert fit[0]["a"] == pytest.approx(10.7, abs=0.2)
+    assert fit[0]["b"] == pytest.approx(13.6, abs=0.2)
+    assert fit[0]["delta"] == pytest.approx(2.9, abs=0.3)
+    # adverse entries rank before benign ones
+    flags = [e["adverse"] for e in joined["entries"]]
+    assert flags == sorted(flags, reverse=True)
+
+
+def test_perf_diff_cli_json_and_perfetto(tmp_path):
+    trace = str(tmp_path / "diff_trace.json")
+    out = io.StringIO()
+    payload = perf_diff.perf_main(
+        ["diff", "r05", "r08", "--json", "--perfetto", trace], out=out)
+    assert json.loads(out.getvalue())["a"] == payload["a"] == "r05"
+    with open(trace) as fd:
+        doc = json.load(fd)
+    assert doc["otherData"]["schema"] == schema.PERFDB_SCHEMA
+    phases = {ev["ph"] for ev in doc["traceEvents"]}
+    assert phases >= {"M", "X"}  # trace-verb-compatible Chrome JSON
+    assert any(ev["ph"] == "X" and ev["dur"] > 0
+               for ev in doc["traceEvents"])
+
+
+# -- regression sentinel ------------------------------------------------------
+
+
+def test_sentinel_names_committed_fit_wall_step(committed_db):
+    db, _ = committed_db
+    result = perf_diff.sentinel(path=db)
+    steps = [s for s in result["steps"]
+             if s["kernel"] == "fit" and s["metric"] == "wall_s"
+             and s["adverse"]]
+    assert len(steps) == 1
+    step = steps[0]
+    # the ISSUE headline: the 10.7 -> 13.6 s step, named by round
+    assert step["round"] == "r07"
+    assert step["prev_round"] == "r05"
+    assert step["prev"] == pytest.approx(10.7, abs=0.2)
+    assert step["settled_round"] == "r08"
+    assert step["settled"] == pytest.approx(13.6, abs=0.2)
+    assert step["pct"] > 15
+    # adverse steps carry the top contributing stage walls
+    assert step["stages"] and all(
+        s["delta_s"] > 0 and s["metric"] in perfdb.WALL_METRICS
+        for s in step["stages"])
+    # settled history: the latest committed round opens no fresh step,
+    # so the post-gate strict posture passes
+    assert result["latest_regressions"] == []
+    perf_diff.perf_main(["sentinel", "--db", db, "--strict"],
+                        out=io.StringIO())
+
+
+def test_sentinel_flags_seeded_regression(tmp_path):
+    db = str(tmp_path / "perfdb.jsonl")
+    walls = {"r01": 1.0, "r02": 1.02, "r03": 0.98, "r04": 1.01,
+             "r05": 2.6}
+    perfdb.append([
+        perfdb.make_row("cpu", "t", "stage.hot", {"wall_s": w},
+                        src=f"bench:{r}", round_tag=r)
+        for r, w in walls.items()], path=db)
+    result = perf_diff.sentinel(path=db, repo_root=str(tmp_path))
+    steps = [s for s in result["steps"] if s["adverse"]]
+    assert len(steps) == 1
+    assert steps[0]["round"] == "r05"
+    assert steps[0]["prev"] == pytest.approx(1.01)
+    # the step opened at the trajectory head -> a fresh regression,
+    # which is exactly what --strict turns into a nonzero exit
+    assert result["latest_regressions"] == steps
+    with pytest.raises(SystemExit):
+        perf_diff.perf_main(["sentinel", "--db", db, "--strict"],
+                            out=io.StringIO())
+
+
+def test_detect_steps_polarity_and_merge():
+    # consecutive flagged rounds collapse into one step record
+    pts = {"r01": 1.0, "r02": 1.0, "r03": 1.0,
+           "r04": 2.0, "r05": 2.1, "r06": 2.05}
+    steps, rounds = perf_diff.detect_steps(pts)
+    assert rounds == sorted(pts)
+    assert [s["round"] for s in steps] == ["r04"]
+    assert steps[0]["settled_round"] == "r05"
+    # an improvement is a step too, just not adverse
+    down, _ = perf_diff.detect_steps(
+        {"r01": 2.0, "r02": 2.0, "r03": 2.0, "r04": 1.0})
+    assert down and not down[0]["adverse"]
+    assert perf_diff.higher_is_better("fit_speedup")
+    assert not perf_diff.higher_is_better("wall_s")
+
+
+# -- lookup: recorded knobs applied, absent entries fall through --------------
+
+
+def test_lookup_prefers_lowest_wall(tmp_path):
+    db = str(tmp_path / "perfdb.jsonl")
+    perfdb.record_tuned("cpu", "sig", "fit", {"plan_pad_to": 8},
+                        {"fit_s": 2.0}, path=db, src="t1")
+    perfdb.record_tuned("cpu", "sig", "fit", {"plan_pad_to": 4},
+                        {"fit_s": 1.0}, path=db, src="t2")
+    row = perfdb.lookup("cpu", "sig", kernel="fit", path=db)
+    assert row["knobs"] == {"plan_pad_to": 4}
+    # backend must match (or be the wildcard); absent keys return None
+    assert perfdb.lookup("tpu", "sig", path=db) is None
+    assert perfdb.lookup("cpu", "other", path=db) is None
+    perfdb.record_tuned("*", "any", "fit", {"plan_pad_to": 2},
+                        {"fit_s": 1.0}, path=db)
+    assert perfdb.lookup("tpu", "any", path=db)["backend"] == "*"
+
+
+def _dt_plans(perf_lookup, devices=1):
+    return planner.plan_grid(DT_CONFIGS, devices=devices, n=240,
+                             n_folds=10, tree_overrides=TREE_OVERRIDES,
+                             perf_lookup=perf_lookup)
+
+
+def test_planner_applies_recorded_pad(tmp_path):
+    shape = planner.plan_shape("Flake16", "Decision Tree", n=240,
+                               n_folds=10, tree_overrides=TREE_OVERRIDES)
+    db = str(tmp_path / "perfdb.jsonl")
+    perfdb.record_tuned("cpu", perfdb.shape_sig(shape), "fit",
+                        {"plan_pad_to": 4}, {"fit_s": 1.0}, path=db)
+    (plan,) = _dt_plans(perfdb.plan_lookup("cpu", path=db))
+    assert plan.batch == 4 and plan.pad == 2
+    # absent database: plan_lookup is None and the plan is today's
+    assert perfdb.plan_lookup("cpu", path=str(tmp_path / "no.jsonl")) \
+        is None
+    (base,) = _dt_plans(None)
+    assert (base.batch, base.pad) == (2, 0)
+
+
+def test_planner_rejects_invalid_pad(tmp_path):
+    # a recorded pad that is not a positive multiple of the device
+    # count falls through to the default — never a broken plan
+    shape = planner.plan_shape("Flake16", "Decision Tree", n=240,
+                               n_folds=10, tree_overrides=TREE_OVERRIDES)
+    (base,) = _dt_plans(None, devices=2)
+    for bad in (0, -4, "x", None, 3):  # 3 not a multiple of devices=2
+        db = str(tmp_path / f"db_{bad}.jsonl")
+        perfdb.record_tuned("cpu", perfdb.shape_sig(shape), "fit",
+                            {"plan_pad_to": bad}, {"fit_s": 1.0}, path=db)
+        (plan,) = _dt_plans(perfdb.plan_lookup("cpu", path=db), devices=2)
+        assert (plan.batch, plan.pad) == (base.batch, base.pad)
+
+
+def test_engine_scores_bit_identical_under_recorded_pad(
+        tmp_path, monkeypatch):
+    # The whole consult chain live: a recorded plan_pad_to reshapes the
+    # batch, yet the DT grower's scores stay BIT-identical — the knob is
+    # result-neutral by the Plan masking contract.
+    from flake16_framework_tpu.parallel import sweep
+    from flake16_framework_tpu.utils.synth import make_dataset
+
+    def engine():
+        feats, labels, pids = make_dataset(
+            n_tests=240, n_projects=6, seed=11)
+        names = [f"project{p:02d}" for p in range(6)]
+        projects = np.array([names[p] for p in pids])
+        return sweep.SweepEngine(
+            feats, labels, projects, names, pids, max_depth=24,
+            tree_overrides=TREE_OVERRIDES, planner_mode=True)
+
+    monkeypatch.delenv("F16_PERFDB", raising=False)
+    ref = engine().run_grid(DT_CONFIGS)
+
+    db = str(tmp_path / "perfdb.jsonl")
+    shape = planner.plan_shape("Flake16", "Decision Tree", n=240,
+                               n_folds=10, tree_overrides=TREE_OVERRIDES)
+    perfdb.record_tuned("cpu", perfdb.shape_sig(shape), "fit",
+                        {"plan_pad_to": 4}, {"fit_s": 1.0}, path=db)
+    monkeypatch.setenv("F16_PERFDB", db)
+    assert perfdb.plan_lookup("cpu")(shape) == {"plan_pad_to": 4}
+    scores = engine().run_grid(DT_CONFIGS)
+
+    assert set(scores) == set(ref) == set(DT_CONFIGS)
+    for keys in DT_CONFIGS:
+        assert scores[keys][2] == ref[keys][2]
+        assert scores[keys][3] == ref[keys][3]
+
+
+def test_serve_buckets_consult_and_fallthrough(tmp_path, monkeypatch):
+    from flake16_framework_tpu.serve import service
+
+    monkeypatch.delenv("F16_PERFDB", raising=False)
+    assert service.resolve_buckets(None) == service.DEFAULT_BUCKETS
+    assert service.DEFAULT_BUCKETS == (8, 32, 128)
+
+    db = str(tmp_path / "perfdb.jsonl")
+    perfdb.record_tuned("*", "serve", "serve",
+                        {"serve_buckets": [16, 4]}, {"p99_ms": 1.0},
+                        path=db)
+    monkeypatch.setenv("F16_PERFDB", db)
+    assert service.resolve_buckets(None) == (4, 16)
+    # an explicit ladder always wins over the recorded one
+    assert service.resolve_buckets((64, 2)) == (2, 64)
+    # a malformed recorded knob must never change serve behavior
+    bad = str(tmp_path / "bad.jsonl")
+    perfdb.record_tuned("*", "serve", "serve",
+                        {"serve_buckets": [0, -2]}, {"p99_ms": 1.0},
+                        path=bad)
+    monkeypatch.setenv("F16_PERFDB", bad)
+    assert service.resolve_buckets(None) == service.DEFAULT_BUCKETS
+    # F16_PERFDB=0 disables the store entirely
+    monkeypatch.setenv("F16_PERFDB", "0")
+    assert perfdb.default_db() is None
+    assert service.resolve_buckets(None) == service.DEFAULT_BUCKETS
+
+
+# -- satellites: attrib tie-break, CLI, smoke ---------------------------------
+
+
+def test_report_attrib_deterministic_tiebreak():
+    # equal walls must rank by config code, then stage name — never
+    # dict-iteration order
+    events = [
+        {"kind": "span", "stage": "fit", "wall_s": 1.0, "config": "ZZ"},
+        {"kind": "span", "stage": "fit", "wall_s": 1.0, "config": "AA"},
+        {"kind": "span", "stage": "predict", "wall_s": 0.5,
+         "configs": ["ZZ", "AA"]},
+    ]
+    attrib = report.summarize_attrib({"run": "t"}, events)
+    assert list(attrib["configs"]) == ["AA", "ZZ"]
+    again = report.summarize_attrib({"run": "t"}, list(reversed(events)))
+    assert list(again["configs"]) == ["AA", "ZZ"]
+    assert attrib["configs"] == again["configs"]
+
+
+def test_perf_cli_lookup_and_ingest(tmp_path):
+    db = str(tmp_path / "perfdb.jsonl")
+    audit_doc = {"schema": schema.AUDIT_SCHEMA, "backend": "cpu",
+                 "envelopes": [{"entry": "sweep.fit", "peak_mb": 12.5,
+                                "arg_bytes": 1e6, "out_bytes": 2e6}]}
+    audit_path = str(tmp_path / "audit.json")
+    with open(audit_path, "w") as fd:
+        json.dump(audit_doc, fd)
+    out = io.StringIO()
+    perf_diff.perf_main(["ingest", audit_path, "--db", db], out=out)
+    (row,) = perfdb.load(db)
+    assert row["kernel"] == "audit.sweep.fit"
+    assert row["metrics"]["peak_mb"] == 12.5
+
+    perfdb.record_tuned("cpu", "sig", "fit", {"plan_pad_to": 4},
+                        {"fit_s": 1.0}, path=db)
+    out = io.StringIO()
+    payload = perf_diff.perf_main(
+        ["lookup", "cpu", "sig", "fit", "--db", db, "--json"], out=out)
+    assert payload["knobs"] == {"plan_pad_to": 4}
+    assert json.loads(out.getvalue())["knobs"] == {"plan_pad_to": 4}
+
+
+def test_perfdb_smoke_tool():
+    # tier-1 arm of tools/perfdb_smoke.py (metrics_smoke pattern)
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import perfdb_smoke
+        out = io.StringIO()
+        assert perfdb_smoke.main([], out=out) == 0
+        assert "perfdb_smoke: OK" in out.getvalue()
+    finally:
+        sys.path.pop(0)

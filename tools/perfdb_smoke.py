@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Performance-observatory smoke test (ISSUE 16e) — tier-1 CI arm.
+
+Backfills the committed BENCH_r*.json trajectory into a throwaway
+database, then proves the whole plane end to end: every row CRC-valid
+against the wire schema, the backfill idempotent, a torn tail recovered
+without losing history, ``perf diff r05 r08`` ranking the fit-wall
+delta, the sentinel flagging the known r05->r07/r08 fit-wall step, and
+``lookup`` round-tripping a tuned knob row. Exit 0 iff all hold.
+
+    python tools/perfdb_smoke.py [--db PATH] [--verbose]
+
+tests/test_perfdb.py invokes main() in-process, so the smoke is part of
+the tier-1 suite as well as a standalone operator probe.
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from flake16_framework_tpu.obs import perf_diff, perfdb, schema  # noqa: E402
+
+
+def main(argv=None, out=sys.stdout):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    db = None
+    verbose = False
+    it = iter(argv)
+    for a in it:
+        if a == "--db":
+            db = next(it)
+        elif a == "--verbose":
+            verbose = True
+        else:
+            raise SystemExit(f"unknown option {a!r}")
+    tmp = None
+    if db is None:
+        tmp = tempfile.TemporaryDirectory(prefix="perfdb-smoke-")
+        db = os.path.join(tmp.name, "perfdb.jsonl")
+
+    problems = []
+    try:
+        rounds = perfdb.backfill(path=db)
+        n_first = sum(rounds.values())
+        if len(rounds) < 9:
+            problems.append(f"only {len(rounds)} committed rounds found")
+        if not n_first:
+            problems.append("backfill wrote zero rows")
+        if any(perfdb.backfill(path=db).values()):
+            problems.append("backfill is not idempotent")
+
+        rows = perfdb.load(db)
+        if len(rows) != n_first:
+            problems.append(
+                f"load returned {len(rows)} rows, wrote {n_first}")
+        for row in rows[:50]:
+            problems += schema.validate_perfdb_row(row)
+        idents = [perfdb.row_identity(r) for r in rows]
+        if len(idents) != len(set(idents)):
+            problems.append("duplicate row identities after backfill")
+
+        # torn-tail drill: garbage appended by a dying writer must be
+        # cut on the next append, with zero history lost
+        with open(db, "ab") as fd:
+            fd.write(b'{"schema": "torn')
+        perfdb.record_tuned("cpu", "serve", "serve",
+                            {"serve_buckets": [4, 16]},
+                            {"p99_ms": 1.0}, path=db)
+        after = perfdb.load(db)
+        if len(after) != n_first + 1:
+            problems.append(
+                f"torn-tail recovery lost rows: {len(after)} != "
+                f"{n_first + 1}")
+
+        row = perfdb.lookup("cpu", "serve", kernel="serve", path=db)
+        if row is None or row["knobs"].get("serve_buckets") != [4, 16]:
+            problems.append("lookup did not return the tuned knob row")
+        if perfdb.lookup("cpu", "no-such-shape", path=db) is not None:
+            problems.append("lookup invented a row for an absent key")
+
+        joined = perf_diff.diff_rows(
+            perf_diff.resolve_rows("r05")[1],
+            perf_diff.resolve_rows("r08")[1])
+        fit = [e for e in joined["entries"]
+               if e["kernel"] == "fit" and e["metric"] == "wall_s"]
+        if not fit or not fit[0]["adverse"] or fit[0]["delta"] <= 0:
+            problems.append("diff r05 r08 did not rank the fit-wall "
+                            "regression as adverse")
+
+        result = perf_diff.sentinel(rows=after)
+        steps = [s for s in result["steps"]
+                 if s["kernel"] == "fit" and s["metric"] == "wall_s"
+                 and s["adverse"]]
+        if not steps:
+            problems.append("sentinel missed the committed fit-wall step")
+        elif steps[0]["round"] not in ("r07", "r08"):
+            problems.append(
+                f"sentinel named round {steps[0]['round']} for the "
+                "fit-wall step, want r07/r08")
+
+        if verbose:
+            out.write(perf_diff.render_sentinel(result) + "\n")
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+    if problems:
+        for p in problems:
+            out.write(f"perfdb_smoke: {p}\n")
+        out.write(f"perfdb_smoke: FAIL ({len(problems)} problem(s))\n")
+        return 1
+    out.write(f"perfdb_smoke: OK ({n_first} rows, {len(rounds)} rounds, "
+              "diff+sentinel+lookup verified)\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

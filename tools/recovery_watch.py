@@ -14,9 +14,12 @@ Chain on recovery (each stage bounded, logged to _scratch/watcher_r03.log):
                                  catches a listener with a dead upstream)
   2. hw_probe full stages      — per-stage timings, pre-warms .jax_cache
   3. bench.py                  — headline JSON -> _scratch/bench_tpu.json
-     (+ bench.py --serve, then the CPU-pinned chaos_drill kill/drain
-      acceptance -> _scratch/chaos_drill.json; chaos FAIL is logged,
-      never aborts the device chain)
+     (+ bench.py --serve, then the perfdb stage: backfill + ingest the
+      fresh TPU bench records into _scratch/perfdb.jsonl and run the
+      trajectory regression sentinel — evidence, never chain-aborting —
+      then the CPU-pinned chaos_drill kill/drain acceptance ->
+      _scratch/chaos_drill.json; chaos FAIL is logged, never aborts the
+      device chain)
   4. parity.py --full          — PARITY.json at repo root (±0.01 criterion)
   5. hw_probe tune_hist+shap   — knob sweeps (results-neutral: per-node
                                  RNG keys derive from node ids; the SHAP
@@ -337,6 +340,25 @@ def chain():
     persist_bench_json(out_s, "bench_serve_tpu.json")
     if not stage_ok_to_continue(ok_s, err):
         return False
+    # Performance observatory (ISSUE 16): bank the fresh TPU bench
+    # records (and the committed-trajectory backfill) into the perf
+    # database and run the regression sentinel over the whole
+    # trajectory. Evidence, not a gate — a flagged step is exactly what
+    # the next session needs to see, so the chain continues either way;
+    # CPU-pinned like audit (the verb never dispatches).
+    ingest = [os.path.join(REPO, "_scratch", f)
+              for f in ("bench_tpu.json", "bench_serve_tpu.json")
+              if os.path.isfile(os.path.join(REPO, "_scratch", f))]
+    run_stage("perfdb",
+              [py, "-m", "flake16_framework_tpu", "perf", "backfill"],
+              300, env_extra={"JAX_PLATFORMS": "cpu"})
+    if ingest:
+        run_stage("perfdb_ingest",
+                  [py, "-m", "flake16_framework_tpu", "perf", "ingest"]
+                  + ingest, 300, env_extra={"JAX_PLATFORMS": "cpu"})
+    run_stage("perfdb_sentinel",
+              [py, "-m", "flake16_framework_tpu", "perf", "sentinel"],
+              300, env_extra={"JAX_PLATFORMS": "cpu"})
     # Crash-tolerance drills (ISSUE 11): the kill drill (SIGKILL mid-fold
     # -> supervised restart -> journal replay -> bit-identical scores) and
     # the drain drill (SIGTERM -> graceful drain -> reload-warm manifest).
