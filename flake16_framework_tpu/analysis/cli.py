@@ -2,6 +2,7 @@
 
     python -m flake16_framework_tpu lint [PATHS...] [--json]
         [--baseline FILE] [--telemetry PATH] [--rules] [--ir]
+        [--concurrency]
     python -m flake16_framework_tpu audit [--json] [--budget-mb MB]
         [--n N] [--trees T] [--max-depth D] [--no-mesh]
 
@@ -14,7 +15,9 @@ schema family as telemetry, validated by the same drift lint).
 validates emitted telemetry documents at PATH (repeatable — the folded-in
 tools/check_telemetry_schema.py behavior). ``--rules`` prints the rule
 catalog and exits 0. ``--ir`` folds the f16audit IR findings into the
-lint run (imports jax — the one lint path that does).
+lint run (imports jax — the one lint path that does). ``--concurrency``
+restricts the run to the f16race pack (C101–C503, rules_conc) — the
+focused invocation for auditing the threaded serving substrate.
 
 ``audit`` is the standalone f16audit gate: trace every real entry point
 (planner family programs, serve AOT executables, both SHAP kernels) and
@@ -32,12 +35,14 @@ import sys
 
 from flake16_framework_tpu.analysis import engine as eng
 from flake16_framework_tpu.analysis import (
-    rules_grid, rules_ir, rules_jax, rules_obs,
+    rules_conc, rules_grid, rules_ir, rules_jax, rules_obs,
 )
 
 # rules_ir registers its catalog only (no check_* hooks): plain lint
 # stays jax-free; I-findings come from run_audit via ``audit``/``--ir``.
-PACKS = (rules_jax, rules_grid, rules_obs, rules_ir)
+# rules_conc (f16race, C101–C503) runs in every lint — pure AST like the
+# rest, dogfooded to zero on the package.
+PACKS = (rules_jax, rules_grid, rules_obs, rules_ir, rules_conc)
 
 
 def default_paths():
@@ -45,15 +50,16 @@ def default_paths():
     return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
 
 
-def build_engine():
-    return eng.Engine(PACKS)
+def build_engine(packs=None):
+    return eng.Engine(PACKS if packs is None else packs)
 
 
 def run_lint(paths=None, baseline_file=None, telemetry_paths=(),
-             ir=False):
+             ir=False, packs=None):
     """(LintResult, telemetry-doc findings folded in) for PATHS. With
-    ``ir`` the f16audit IR findings join the result (imports jax)."""
-    engine = build_engine()
+    ``ir`` the f16audit IR findings join the result (imports jax).
+    ``packs`` restricts the run (the ``--concurrency`` focus flag)."""
+    engine = build_engine(packs)
     result = engine.lint(paths or default_paths(),
                          baseline=eng.load_baseline(baseline_file,
                                                     rules=engine.rules))
@@ -70,6 +76,7 @@ def lint_main(args, out=None):
     as_json = False
     show_rules = False
     with_ir = False
+    conc_only = False
     baseline = None
     telemetry = []
     paths = []
@@ -81,6 +88,8 @@ def lint_main(args, out=None):
             show_rules = True
         elif a == "--ir":
             with_ir = True
+        elif a == "--concurrency":
+            conc_only = True
         elif a == "--baseline":
             baseline = next(it, None)
             if baseline is None:
@@ -95,14 +104,15 @@ def lint_main(args, out=None):
         else:
             paths.append(a)
 
+    packs = (rules_conc,) if conc_only else None
     if show_rules:
-        engine = build_engine()
+        engine = build_engine(packs)
         for r in sorted(engine.rules.values(), key=lambda r: r.id):
             out.write(f"{r.id:<6}{r.severity:<9}{r.doc}\n")
         return 0
 
     result = run_lint(paths, baseline_file=baseline,
-                      telemetry_paths=telemetry, ir=with_ir)
+                      telemetry_paths=telemetry, ir=with_ir, packs=packs)
     report = result.to_report()
     if as_json:
         out.write(json.dumps(report, indent=1) + "\n")

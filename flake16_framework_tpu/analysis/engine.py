@@ -46,7 +46,7 @@ BASELINE_SCHEMA_V1 = "flake16-lint-baseline-v1"
 # fingerprint format (``RULE:hash``) keeps the rule id recoverable, so
 # grouping needs no extra bookkeeping at save time.
 PACK_PREFIXES = {"E": "engine", "J": "jax", "G": "grid", "O": "obs",
-                 "I": "ir"}
+                 "I": "ir", "C": "concurrency"}
 
 
 def pack_of(rule_id):
@@ -220,6 +220,10 @@ class LintResult:
             "rules": {r.id: {"severity": r.severity, "doc": r.doc}
                       for r in sorted(self.rules.values(),
                                       key=lambda r: r.id)},
+            # Additive (validate_lint_report is permissive on extras, so
+            # flake16-lint-report-v1 consumers are unaffected): the pack
+            # sections this run's catalog spans, baseline-v2 vocabulary.
+            "packs": sorted({pack_of(rid) for rid in self.rules}),
         }
 
 

@@ -126,6 +126,10 @@ KNOBS = {
     # Performance-observatory database path (obs/perfdb.py): a file
     # path, "" for the _scratch default, "0" disables the consult.
     "F16_PERFDB": ("str", None),
+    # The f16race runtime lock-order witness (obs/lockwatch.py): "1"
+    # arms the tracer and dumps lockwatch.json to the CWD at exit; any
+    # other non-empty value is the dump path; ""/"0" leaves it off.
+    "F16_LOCKWATCH": ("str", None),
 }
 
 # The PAPER's grid size — historical reference only. The pre-flight's

@@ -11,6 +11,13 @@ telemetry is off, so instrumentation can live directly in
 pipeline/sweep/bench code without a perf tax.
 """
 
+# The lock-order witness must arm BEFORE obs.core runs — core's
+# module-level locks have to be minted by the patched factories for
+# lockwatch to see them (obs/lockwatch.py; no-op unless F16_LOCKWATCH).
+from flake16_framework_tpu.obs import lockwatch as _lockwatch
+
+_lockwatch.maybe_install_from_env()
+
 from flake16_framework_tpu.obs.core import (  # noqa: F401
     Span,
     append_jsonl,

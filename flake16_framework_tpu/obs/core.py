@@ -311,7 +311,8 @@ def gauge(name, value, **fields):
     if state is None or value is None:
         return
     value = round(float(value), 4)
-    state.gauges[name] = value  # last-value, flushed into the manifest
+    with _lock:  # last-value, flushed into the manifest; dict writes
+        state.gauges[name] = value  # race from serve worker threads
     _emit(state, {"kind": "gauge", "name": name, "value": value, **fields})
 
 

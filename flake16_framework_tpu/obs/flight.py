@@ -127,11 +127,15 @@ class FlightRecorder:
             self._write_header()
 
     def close(self):
-        try:
-            self._mm.flush()
-            self._mm.close()
-        except (ValueError, OSError):
-            pass
+        # Under the ring lock: a record() racing close() must either
+        # complete against the live mmap or see the closed one's
+        # ValueError — never interleave with flush (f16race dogfood).
+        with self._lock:
+            try:
+                self._mm.flush()
+                self._mm.close()
+            except (ValueError, OSError):
+                pass
 
 
 # -- replay (the parent / report side; plain reads, no mmap) ------------

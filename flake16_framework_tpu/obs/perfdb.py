@@ -38,6 +38,7 @@ the tuning database ROADMAP item 3's autotuner will write into
 import hashlib
 import json
 import os
+import threading
 import time
 import zlib
 
@@ -45,6 +46,15 @@ from flake16_framework_tpu.obs import core, schema
 
 DB_ENV = "F16_PERFDB"
 DB_FILE = os.path.join("_scratch", "perfdb.jsonl")
+
+# Serializes IN-PROCESS appenders (bench rounds and run ingestion can
+# share a process with serve's drain flush): recover->dedup->append must
+# be atomic or two appenders double-write the same identity (f16race
+# dogfood). CROSS-process writers stay single-writer by contract — the
+# CLI and bench own the db path for the duration of a run — and a
+# crashed writer's torn tail is healed by ``recover`` on the next
+# append, not by locking.
+_append_lock = threading.Lock()
 
 # Repo root (committed BENCH_rNN.json live beside the package dir).
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -212,15 +222,16 @@ def append(rows, path=None):
     parent = os.path.dirname(path)
     if parent:
         os.makedirs(parent, exist_ok=True)
-    recover(path)
-    seen = {row_identity(r) for r in load(path)}
-    n = 0
-    for row in rows:
-        if row_identity(row) in seen:
-            continue
-        seen.add(row_identity(row))
-        core.append_jsonl(path, row)
-        n += 1
+    with _append_lock:
+        recover(path)
+        seen = {row_identity(r) for r in load(path)}
+        n = 0
+        for row in rows:
+            if row_identity(row) in seen:
+                continue
+            seen.add(row_identity(row))
+            core.append_jsonl(path, row)
+            n += 1
     if n:
         core.event("perf", action="append", n=n, path=path)
     return n

@@ -37,6 +37,11 @@ AUDIT_SCHEMA = "flake16-audit-report-v1"
 # observation. The ONLY place this literal may appear in the package —
 # rows must stamp the constant (O106 guards against a drifted copy).
 PERFDB_SCHEMA = "flake16-perfdb-v1"
+# The lockwatch dynamic lock-order document (obs/lockwatch.py): lock
+# creation sites + the observed held->acquired order edges, written at
+# exit when F16_LOCKWATCH is armed and reconciled against the static
+# f16race C201 model (analysis/concurrency.build_lock_model).
+LOCKWATCH_SCHEMA = "flake16-lockwatch-v1"
 
 _NUM = (int, float)
 

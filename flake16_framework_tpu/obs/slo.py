@@ -177,14 +177,20 @@ class SLOMonitor:
                 from flake16_framework_tpu.resilience import ladder
 
                 degraded = ladder.mark_pallas_broken(kernel=cfg.kernel)
-                self._took_rung = self._took_rung or degraded
+                if degraded:
+                    # _took_rung is shared with concurrent evaluate()
+                    # callers (dispatcher pool) — flip it under the
+                    # monitor lock, taken AFTER the ladder's released.
+                    with self._lock:
+                        self._took_rung = True
             core.event("slo", state="breach", degraded=degraded, **state)
         elif recover:
-            if self._took_rung:
+            with self._lock:
+                took_rung, self._took_rung = self._took_rung, False
+            if took_rung:
                 from flake16_framework_tpu.resilience import ladder
 
                 ladder.clear_pallas_broken(kernel=cfg.kernel)
-                self._took_rung = False
             core.event("slo", state="recovered", **state)
         return state
 

@@ -32,6 +32,7 @@ top rung (tests; a fresh process starts there anyway).
 
 import contextlib
 import sys
+import threading
 
 from flake16_framework_tpu import obs
 from flake16_framework_tpu.resilience import faults
@@ -59,6 +60,15 @@ class DegradationState:
 
 
 _STATE = DegradationState()
+# Serializes ladder TRANSITIONS (step / mark / clear / reset): the guard's
+# retry workers, the SLO monitor (via dispatcher threads), and the serve
+# drain can all step the ladder concurrently, and check-then-set on the
+# rungs must be atomic (f16race dogfood). READS (state/halved/
+# pallas_broken) stay lock-free on purpose — each is a single attribute
+# load of a monotonic-ish flag, and a stale read only costs one retry at
+# the old rung. Telemetry is emitted AFTER release, mirroring obs/slo.py:
+# the ladder must never hold its lock into the event sink's.
+_lock = threading.Lock()
 
 
 def state():
@@ -67,10 +77,11 @@ def state():
 
 def reset():
     """Back to the top rung (per-process; mainly for tests)."""
-    _STATE.pallas_broken = False
-    _STATE.halvings = 0
-    _STATE.cpu_fallback = False
-    _STATE.pallas_broken_kernels = set()
+    with _lock:
+        _STATE.pallas_broken = False
+        _STATE.halvings = 0
+        _STATE.cpu_fallback = False
+        _STATE.pallas_broken_kernels = set()
 
 
 def halved(chunk):
@@ -85,19 +96,21 @@ def step(fault_class, *, attempt=0, context=None):
     """Take one ladder step for a fault class; returns the step name, or
     None when the class has no rung (transient faults just retry) or the
     ladder is already at its floor. Emits the ``fault``/degrade event."""
-    if fault_class in (faults.OOM, faults.ENVELOPE_OVERRUN):
-        if _STATE.halvings >= MAX_HALVINGS:
+    with _lock:
+        if fault_class in (faults.OOM, faults.ENVELOPE_OVERRUN):
+            if _STATE.halvings >= MAX_HALVINGS:
+                return None
+            _STATE.halvings += 1
+            action = "halve-chunk"
+        elif fault_class == faults.RELAY_DOWN:
+            if _STATE.cpu_fallback:
+                return None
+            _STATE.cpu_fallback = True
+            action = "cpu-fallback"
+        else:
             return None
-        _STATE.halvings += 1
-        action = "halve-chunk"
-    elif fault_class == faults.RELAY_DOWN:
-        if _STATE.cpu_fallback:
-            return None
-        _STATE.cpu_fallback = True
-        action = "cpu-fallback"
-    else:
-        return None
-    fields = {"step": action, "halvings": _STATE.halvings}
+        halvings = _STATE.halvings
+    fields = {"step": action, "halvings": halvings}
     if context:
         fields["config"] = context
     obs.event("fault", fault_class=fault_class, action="degrade",
@@ -117,12 +130,13 @@ def mark_pallas_broken(exc=None, kernel="shap"):
     """The pallas->xla rung, per kernel (ops/treeshap.py's auto fallback
     for "shap", ops/trees.py's hist-grower fallback for "hist").
     Returns True on the FIRST marking — callers use that to warn once."""
-    if pallas_broken(kernel):
-        return False
-    if kernel == "shap":
-        _STATE.pallas_broken = True
-    else:
-        _STATE.pallas_broken_kernels.add(kernel)
+    with _lock:
+        if pallas_broken(kernel):
+            return False
+        if kernel == "shap":
+            _STATE.pallas_broken = True
+        else:
+            _STATE.pallas_broken_kernels.add(kernel)
     obs.event("fault",
               fault_class=(faults.classify(exc) if exc is not None
                            else faults.DETERMINISTIC),
@@ -138,12 +152,13 @@ def clear_pallas_broken(kernel="shap"):
     ``mark_pallas_broken`` to shed kernel latency, and once the burn
     clears the fast arm is restored. Returns True when the rung was
     actually set (mirrors ``mark_pallas_broken``'s first-marking True)."""
-    if not pallas_broken(kernel):
-        return False
-    if kernel == "shap":
-        _STATE.pallas_broken = False
-    else:
-        _STATE.pallas_broken_kernels.discard(kernel)
+    with _lock:
+        if not pallas_broken(kernel):
+            return False
+        if kernel == "shap":
+            _STATE.pallas_broken = False
+        else:
+            _STATE.pallas_broken_kernels.discard(kernel)
     obs.event("fault", fault_class=faults.DETERMINISTIC,
               action="recovered", attempt=0, step="pallas-restored",
               kernel=kernel)

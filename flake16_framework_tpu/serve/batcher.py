@@ -43,6 +43,11 @@ class Microbatcher:
         self.stats = stats
         self.monitor = monitor  # obs.slo.SLOMonitor (None = no SLO loop)
         self.quarantined = {}
+        # Guards quarantined writes: every dispatcher-pool worker can
+        # quarantine on an abandoned dispatch (f16race C101). Admission
+        # reads stay lock-free — a stale miss admits one request that
+        # fails with the same DispatchAbandoned, which is benign.
+        self._quarantine_lock = threading.Lock()
         self.inflight = 0  # dispatches currently inside _run_batch
         self._inflight_lock = threading.Lock()
         self._handoff = _stdqueue.Queue(maxsize=int(max_inflight))
@@ -179,11 +184,12 @@ class Microbatcher:
                         label=f"serve:{req0.model_id}:{req0.kind}")
         except Exception as e:
             if isinstance(e, _guard.DispatchAbandoned):
-                self.quarantined[req0.model_id] = {
-                    "fault_class": e.fault_class,
-                    "attempts": len(e.attempts),
-                    "kind": req0.kind,
-                }
+                with self._quarantine_lock:
+                    self.quarantined[req0.model_id] = {
+                        "fault_class": e.fault_class,
+                        "attempts": len(e.attempts),
+                        "kind": req0.kind,
+                    }
             self._fail_batch(batch, e)
             return
 

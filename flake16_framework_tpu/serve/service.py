@@ -231,6 +231,13 @@ class ScoringService:
                 f"drain deadline ({deadline_s}s) exceeded; "
                 f"batch aborted before dispatch"))
         self.flush()
+        if self.metrics is not None:
+            # Join the exporter like every other worker (f16race
+            # dogfood): its ThreadingHTTPServer thread must not outlive
+            # the drained service holding the port and scraping
+            # callbacks into torn-down state.
+            self.metrics.stop()
+            self.metrics = None
         self._started = False
         acct = {
             "phase": "complete" if clean else "abort",

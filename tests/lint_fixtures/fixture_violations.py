@@ -6,6 +6,10 @@ The imports below exist so the alias resolver sees realistic bindings.
 """
 
 import functools
+import os
+import signal
+import subprocess
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -93,3 +97,60 @@ def suppressed_examples(xs):
     for x in xs:
         jax.block_until_ready(x)  # f16lint: disable=J402
     return xs
+
+
+# -- f16race (rules_conc) seeds ------------------------------------------
+
+_fix_lock_a = threading.Lock()
+_fix_lock_b = threading.Lock()
+_fix_state = {"n": 0}
+
+
+def _conc_worker():
+    _fix_state["n"] = _fix_state["n"] + 1          # expect C101
+    with _fix_lock_a:
+        with _fix_lock_b:                          # expect C201
+            pass
+
+
+def _conc_worker_rev():
+    with _fix_lock_b:
+        with _fix_lock_a:
+            pass
+
+
+def conc_reset():
+    _fix_state["n"] = 0
+
+
+def conc_start_threads():
+    threading.Thread(target=_conc_worker).start()
+    threading.Thread(target=_conc_worker_rev).start()
+
+
+@hot_path
+def conc_blocking_under_lock(fut):
+    with _fix_lock_a:
+        return fut.result()                        # expect C301
+
+
+def _conc_handler(signum, frame):
+    print("terminating", signum)                   # expect C401
+
+
+def conc_install_handler():
+    signal.signal(signal.SIGTERM, _conc_handler)
+
+
+def conc_fork_after_threads():
+    return os.fork()                               # expect C501
+
+
+def conc_mp_fork():
+    import multiprocessing
+
+    return multiprocessing.Process(target=conc_reset)       # expect C502
+
+
+def conc_preexec():
+    return subprocess.Popen(["true"], preexec_fn=conc_reset)  # expect C503

@@ -1,0 +1,390 @@
+"""f16race — static concurrency auditor + runtime lock-order witness
+(ISSUE 17).
+
+Covers: the thread-topology builder on a synthetic module (roots,
+multi-instance detection, self-attr target resolution, per-function
+reachability), every C-rule firing on the seeded fixture, a seeded
+two-lock inversion reported as a C201 cycle naming both locks, the
+lockwatch tracer round-trip (install -> trace -> snapshot -> reconcile,
+plus cycle and subgraph mismatch detection), an in-process serve drill
+reconciled against the package's static lock model, and the dogfood
+gate: ``lint --concurrency`` over the real package is clean.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "lint_fixtures",
+                       "fixture_violations.py")
+PACKAGE = os.path.join(REPO, "flake16_framework_tpu")
+
+from flake16_framework_tpu.analysis import Engine, Module  # noqa: E402
+from flake16_framework_tpu.analysis import concurrency as conc  # noqa: E402
+from flake16_framework_tpu.analysis import rules_conc  # noqa: E402
+from flake16_framework_tpu.obs import lockwatch, schema  # noqa: E402
+
+SYNTH = '''\
+import signal
+import threading
+
+_lock = threading.Lock()
+_other = threading.Lock()
+_shared = {"n": 0}
+
+
+class Worker:
+    def __init__(self):
+        self._runner = threading.Thread(target=self._run)
+
+    def start(self):
+        self._runner.start()
+
+    def _run(self):
+        with _lock:
+            _shared["n"] = _shared["n"] + 1
+
+
+def _tick():
+    with _lock:
+        with _other:
+            pass
+
+
+def arm():
+    threading.Timer(1.0, _tick).start()
+    signal.signal(signal.SIGTERM, _handler)
+
+
+def _handler(signum, frame):
+    pass
+
+
+def fan_out():
+    for _ in range(4):
+        threading.Thread(target=_tick).start()
+'''
+
+
+def _module(tmp_path, source, name="synth_mod.py"):
+    path = tmp_path / name
+    path.write_text(source)
+    return Module(str(path))
+
+
+def _project(tmp_path, source, name="synth_mod.py"):
+    return conc.build_project([_module(tmp_path, source, name)])
+
+
+# -- topology builder ----------------------------------------------------
+
+
+def test_topology_discovers_roots(tmp_path):
+    proj = _project(tmp_path, SYNTH)
+    (mm,) = proj.mods.values()
+    kinds = sorted(r.kind for r in mm.roots)
+    assert kinds == ["signal", "thread", "thread", "thread"]
+    targets = {r.target for r in mm.roots if r.kind == "thread"}
+    assert ("selfattr", "_run") in targets
+    assert ("name", "_tick") in targets
+
+
+def test_topology_multi_instance_roots(tmp_path):
+    proj = _project(tmp_path, SYNTH)
+    (mm,) = proj.mods.values()
+    multi = {r.target: r.multi for r in mm.roots if r.kind == "thread"}
+    # the loop-spawned Thread counts as many instances; the others as one
+    assert multi[("name", "_tick")] is True
+    assert multi[("selfattr", "_run")] is False
+
+
+def test_topology_reachability(tmp_path):
+    proj = _project(tmp_path, SYNTH)
+    (path,) = proj.mods
+    # Worker._run reaches its thread root via the self-attr target AND
+    # main (public start() calls it through the Thread target only, but
+    # __init__/start are main-reachable methods naming it)
+    run_roots = proj.roots_of(path, "Worker._run")
+    assert any(k.startswith("thread:") for k in run_roots)
+    # _tick is reached by the Timer root and the loop-spawned threads,
+    # never by main (private, not toplevel-called)
+    tick_roots = proj.roots_of(path, "_tick")
+    assert all(k.startswith("thread:") for k in tick_roots)
+    assert len(tick_roots) >= 2
+    # the signal handler is reachable from its signal root
+    handler_roots = proj.roots_of(path, "_handler")
+    assert any(k.startswith("signal:") for k in handler_roots)
+    # public entry points are main-reachable
+    assert conc.MAIN_ROOT in proj.roots_of(path, "arm")
+
+
+def test_lock_census_sites_and_ids(tmp_path):
+    proj = _project(tmp_path, SYNTH)
+    (path,) = proj.mods
+    ids = sorted(proj.lock_defs)
+    assert f"{path}:_lock" in ids and f"{path}:_other" in ids
+    for ld in proj.lock_defs.values():
+        site_path, _, lineno = ld.site.rpartition(":")
+        assert site_path == path and int(lineno) > 0
+
+
+def test_order_edges_from_lexical_nesting(tmp_path):
+    proj = _project(tmp_path, SYNTH)
+    (path,) = proj.mods
+    assert (f"{path}:_lock", f"{path}:_other") in proj.edges
+    assert proj.cycles() == []
+
+
+# -- C-rules on seeded sources -------------------------------------------
+
+
+def _lint(paths):
+    return Engine((rules_conc,)).lint(paths)
+
+
+def test_every_c_rule_fires_on_fixture():
+    result = _lint([FIXTURE])
+    fired = {f.rule for f in result.findings}
+    assert fired == set(rules_conc.RULES)
+
+
+INVERSION = '''\
+import threading
+
+_front = threading.Lock()
+_back = threading.Lock()
+
+
+def _forward():
+    with _front:
+        with _back:
+            pass
+
+
+def _backward():
+    with _back:
+        with _front:
+            pass
+
+
+def spawn():
+    threading.Thread(target=_forward).start()
+    threading.Thread(target=_backward).start()
+'''
+
+
+def test_seeded_inversion_reports_c201_naming_locks(tmp_path):
+    path = tmp_path / "inversion.py"
+    path.write_text(INVERSION)
+    result = _lint([str(path)])
+    c201 = [f for f in result.findings if f.rule == "C201"]
+    assert len(c201) == 1, [f.message for f in result.findings]
+    msg = c201[0].message
+    assert "_front" in msg and "_back" in msg
+    assert "inversion" in msg
+
+
+def test_interprocedural_edge_c201(tmp_path):
+    """The inversion is still found when one arm takes the second lock
+    through a callee (may-acquire summaries, not just lexical nesting)."""
+    source = INVERSION.replace(
+        "def _forward():\n    with _front:\n        with _back:\n"
+        "            pass\n",
+        "def _grab_back():\n    with _back:\n        pass\n\n\n"
+        "def _forward():\n    with _front:\n        _grab_back()\n")
+    path = tmp_path / "indirect.py"
+    path.write_text(source)
+    result = _lint([str(path)])
+    assert [f.rule for f in result.findings] == ["C201"]
+
+
+# -- lockwatch: the runtime witness --------------------------------------
+
+
+@pytest.fixture
+def traced():
+    lockwatch.reset()
+    lockwatch.install()
+    yield
+    lockwatch.uninstall()
+    lockwatch.reset()
+
+
+def test_lockwatch_round_trip(traced):
+    a = threading.Lock()
+    b = threading.RLock()
+    with a:
+        with b:
+            pass
+    snap = lockwatch.snapshot()
+    assert snap["schema"] == schema.LOCKWATCH_SCHEMA
+    here = __file__.replace(os.sep, "/")
+    sites = sorted(snap["locks"])
+    assert len(sites) == 2
+    for site in sites:
+        assert os.path.basename(here) in site
+    assert snap["locks"][sites[0]]["kind"] == "lock"
+    assert snap["locks"][sites[1]]["kind"] == "rlock"
+    (edge,) = snap["edges"]
+    assert edge[0] == sites[0] and edge[1] == sites[1] and edge[2] == 1
+
+    model = {"locks": {"m:a": {"site": sites[0], "kind": "lock"},
+                       "m:b": {"site": sites[1], "kind": "rlock"}},
+             "edges": [["m:a", "m:b"]]}
+    rec = lockwatch.reconcile(snap, model)
+    assert rec["ok"] and rec["cycle"] is None
+    assert rec["checked_edges"] == 1 and rec["violations"] == []
+    assert rec["known_locks"] == ["m:a", "m:b"]
+
+
+def test_lockwatch_detects_inverted_order(traced):
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    snap = lockwatch.snapshot()
+    s_a, s_b = snap["edges"][0][0], snap["edges"][0][1]
+    # static model orders them the OTHER way: the dynamic edge is a
+    # latent deadlock against the modeled order
+    model = {"locks": {"m:a": {"site": s_a}, "m:b": {"site": s_b}},
+             "edges": [["m:b", "m:a"]]}
+    rec = lockwatch.reconcile(snap, model)
+    assert not rec["ok"]
+    assert rec["violations"] == [{"edge": ["m:a", "m:b"],
+                                  "why": "inverted"}]
+
+
+def test_lockwatch_detects_dynamic_cycle():
+    dynamic = {"schema": schema.LOCKWATCH_SCHEMA,
+               "locks": {}, "edges": [["x:1", "y:2", 3], ["y:2", "x:1", 1]]}
+    rec = lockwatch.reconcile(dynamic, {"locks": {}, "edges": []})
+    assert not rec["ok"]
+    assert sorted(rec["cycle"]) == ["x:1", "y:2"]
+
+
+def test_lockwatch_foreign_locks_skip_subgraph(traced):
+    # stdlib-minted locks (Queue internals) get stdlib creation sites:
+    # they join the cycle check but never the subgraph check
+    import queue
+
+    q = queue.Queue()
+    q.put(1)
+    q.get()
+    snap = lockwatch.snapshot()
+    rec = lockwatch.reconcile(snap, {"locks": {}, "edges": []})
+    assert rec["ok"]
+    assert rec["checked_edges"] == 0
+
+
+def test_lockwatch_dump_and_reset(traced, tmp_path):
+    lock = threading.Lock()
+    with lock:
+        pass
+    out = tmp_path / "lw.json"
+    assert lockwatch.dump(str(out)) == str(out)
+    doc = json.loads(out.read_text())
+    assert doc["schema"] == schema.LOCKWATCH_SCHEMA
+    assert len(doc["locks"]) == 1
+    lockwatch.reset()
+    assert lockwatch.snapshot()["locks"] == {}
+
+
+def test_lockwatch_site_join_matches_static_model(tmp_path, traced):
+    """The tracer's creation sites ARE the static model's join keys: a
+    module with a module-level lock reconciles non-vacuously."""
+    path = tmp_path / "lw_mod.py"
+    path.write_text("import threading\n\n_lock = threading.Lock()\n")
+    sys.path.insert(0, str(tmp_path))
+    try:
+        import lw_mod
+    finally:
+        sys.path.remove(str(tmp_path))
+    try:
+        with lw_mod._lock:
+            pass
+        snap = lockwatch.snapshot()
+        model = conc.build_lock_model([str(path)])
+        rec = lockwatch.reconcile(snap, model)
+        assert rec["ok"]
+        # the static lock id, observed dynamically through the same site
+        assert rec["known_locks"] == sorted(model["locks"])
+    finally:
+        del sys.modules["lw_mod"]
+
+
+# -- the in-process serve drill, reconciled ------------------------------
+
+
+def test_serve_drill_reconciles_against_static_model(tmp_path):
+    """Tier-1 acceptance: run the serving drill with the witness armed
+    and reconcile the observed lock-order graph against the package's
+    static C201 model — cycle-free, inside the allowed order, with the
+    serving substrate's own locks actually observed."""
+    from flake16_framework_tpu.resilience import ladder
+    from flake16_framework_tpu.serve import ModelRegistry, ScoringService
+    from flake16_framework_tpu.utils.synth import make_dataset
+
+    feats, labels, _ = make_dataset(n_tests=160, seed=7)
+    keys = ("NOD", "Flake16", "None", "None", "Decision Tree")
+
+    ladder.reset()
+    lockwatch.reset()
+    lockwatch.install()
+    try:
+        # the service's locks are minted AFTER install, so the witness
+        # sees the queue condition, latency ring, batcher locks, ...
+        reg = ModelRegistry(str(tmp_path))
+        model = reg.fit_and_register(keys, feats, labels, max_depth=6,
+                                     seed=3)
+        svc = ScoringService(reg, buckets=(4, 16))
+        svc.start()
+        try:
+            out = svc.score(model.model_id, feats[:3], kind="predict",
+                            timeout=60)
+            assert out.shape[0] == 3
+        finally:
+            svc.stop()
+        snap = lockwatch.snapshot()
+    finally:
+        lockwatch.uninstall()
+        lockwatch.reset()
+        ladder.reset()
+
+    model = conc.build_lock_model([PACKAGE])
+    rec = lockwatch.reconcile(snap, model)
+    assert rec["cycle"] is None, rec["cycle"]
+    assert rec["violations"] == [], rec["violations"]
+    assert rec["ok"]
+    # non-vacuous: the serving substrate's statically modeled locks were
+    # dynamically observed under load
+    assert len(rec["known_locks"]) >= 3, rec["known_locks"]
+    assert any("queue.py" in k or "batcher.py" in k or "service.py" in k
+               for k in rec["known_locks"]), rec["known_locks"]
+
+
+# -- dogfood gate --------------------------------------------------------
+
+
+def test_concurrency_gate_package_is_clean():
+    """``lint --concurrency`` over the real package: zero findings, and
+    the --json report declares the pack without breaking lint-report-v1
+    consumers."""
+    r = subprocess.run(
+        [sys.executable, "-m", "flake16_framework_tpu", "lint",
+         "flake16_framework_tpu/", "--concurrency", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-500:]
+    report = json.loads(r.stdout)
+    assert schema.validate_lint_report(report) == []
+    assert report["findings"] == []
+    # the engine's own E-rules always ride along; --concurrency excludes
+    # every other AST pack
+    assert "concurrency" in report["packs"]
+    assert not {"jax", "grid", "obs", "ir"} & set(report["packs"])
+    assert set(rules_conc.RULES) <= set(report["rules"])

@@ -5,7 +5,8 @@ ISSUE-12 planner drill) with no human in the loop.
     python tools/chaos_drill.py plan     # SIGKILL inside a family program
     python tools/chaos_drill.py serve    # the drain drill
     python tools/chaos_drill.py flight   # SIGKILL vs the flight recorder
-    python tools/chaos_drill.py          # all; exit 0 iff every drill PASSes
+    python tools/chaos_drill.py lockwatch  # drain + runtime lock witness
+    python tools/chaos_drill.py          # default set; exit 0 iff all PASS
     python tools/chaos_drill.py --json   # machine-readable verdicts
     python tools/chaos_drill.py --keep   # keep scratch dirs (debugging)
 
@@ -191,27 +192,31 @@ def drill_plan(workdir):
     return _kill_drill(workdir, "plan", PLAN_CONFIGS, planner=True)
 
 
-def drill_serve(workdir):
-    """SIGTERM under load -> graceful drain -> zero dropped -> flushed
-    registry/AOT manifest reloads warm. Returns a verdict dict."""
-    t0 = time.perf_counter()
+def _drain_child(workdir, label, extra_env=None):
+    """Spawn the held serve child, SIGTERM it after SERVE_READY, and
+    return (ready, rc, acct) — shared by the serve and lockwatch
+    drills so both exercise the SAME drain path."""
     reg_dir = os.path.join(workdir, "registry")
     argv = [sys.executable, "-m", "flake16_framework_tpu", "serve",
             "--hold", "--registry", reg_dir, "--synth", "256",
             "--trees", "4", "--max-depth", "8", "--buckets", "8,32",
             "--rows", "8", "--clients", "6",
             "--hold-timeout", "180", "--drain-deadline", "10"]
-    log("serve: spawning held service " + " ".join(argv[2:]))
+    env = None
+    if extra_env:
+        env = dict(os.environ)
+        env.update(extra_env)
+    log(f"{label}: spawning held service " + " ".join(argv[2:]))
     err_log = os.path.join(workdir, "serve.err")
     proc = subprocess.Popen(
         argv, cwd=REPO, stdout=subprocess.PIPE,
-        stderr=open(err_log, "w"), text=True)
+        stderr=open(err_log, "w"), text=True, env=env)
     # Watchdog: a child that never reaches SERVE_READY/DRAIN_ACCT (e.g. a
     # wedged warm-up) must not hang the drill — readline() below blocks.
     watchdog = threading.Timer(600, proc.kill)
     watchdog.start()
 
-    checks, acct = {}, None
+    acct = None
     try:
         ready = False
         for line in proc.stdout:
@@ -219,7 +224,7 @@ def drill_serve(workdir):
             if line == "SERVE_READY" and not ready:
                 ready = True
                 time.sleep(0.5)  # let the client load queue requests
-                log("serve: SERVE_READY seen; sending SIGTERM")
+                log(f"{label}: SERVE_READY seen; sending SIGTERM")
                 proc.send_signal(signal.SIGTERM)
             elif line.startswith("DRAIN_ACCT "):
                 acct = json.loads(line[len("DRAIN_ACCT "):])
@@ -228,7 +233,17 @@ def drill_serve(workdir):
         watchdog.cancel()
         if proc.poll() is None:
             proc.kill()
+    return ready, rc, acct
 
+
+def drill_serve(workdir):
+    """SIGTERM under load -> graceful drain -> zero dropped -> flushed
+    registry/AOT manifest reloads warm. Returns a verdict dict."""
+    t0 = time.perf_counter()
+    reg_dir = os.path.join(workdir, "registry")
+    ready, rc, acct = _drain_child(workdir, "serve")
+
+    checks = {}
     checks["ready_seen"] = ready
     checks["rc0"] = rc == 0
     checks["acct_printed"] = acct is not None
@@ -263,6 +278,53 @@ def drill_serve(workdir):
 
     return {"drill": "serve", "pass": all(checks.values()),
             "checks": checks, "wall_s": round(time.perf_counter() - t0, 2)}
+
+
+def drill_lockwatch(workdir):
+    """The f16race runtime witness (ISSUE 17): re-run the drain drill
+    with ``F16_LOCKWATCH`` armed so the child traces every lock it
+    creates, then reconcile the dumped dynamic lock-order graph against
+    the static C201 model. PASS requires: the child drains cleanly, the
+    witness document lands (schema flake16-lockwatch-v1), the dynamic
+    graph is CYCLE-FREE, every dynamic edge between statically-known
+    locks lies inside the static model's allowed order (no inversion the
+    linter missed, no nesting the model is blind to), and the witness
+    actually observed repo locks — an empty observation would reconcile
+    vacuously."""
+    t0 = time.perf_counter()
+    lw_path = os.path.join(workdir, "lockwatch.json")
+    ready, rc, acct = _drain_child(
+        workdir, "lockwatch", extra_env={"F16_LOCKWATCH": lw_path})
+
+    checks = {}
+    checks["ready_seen"] = ready
+    checks["rc0"] = rc == 0
+    checks["drained"] = (acct is not None
+                         and acct["drain"]["phase"] == "complete")
+    checks["dump_written"] = os.path.exists(lw_path)
+    verdict = {"drill": "lockwatch"}
+    if checks["dump_written"]:
+        from flake16_framework_tpu.analysis import concurrency
+        from flake16_framework_tpu.obs import lockwatch, schema
+
+        with open(lw_path) as fd:
+            doc = json.load(fd)
+        checks["dump_schema"] = doc.get("schema") == schema.LOCKWATCH_SCHEMA
+        model = concurrency.build_lock_model(
+            [os.path.join(REPO, "flake16_framework_tpu")])
+        rec = lockwatch.reconcile(doc, model, root=REPO)
+        checks["cycle_free"] = rec["cycle"] is None
+        checks["static_subgraph"] = not rec["violations"]
+        checks["repo_locks_observed"] = len(rec["known_locks"]) >= 3
+        log(f"lockwatch: {len(doc.get('locks', {}))} lock site(s), "
+            f"{len(doc.get('edges', []))} order edge(s), "
+            f"{len(rec['known_locks'])} statically modeled")
+        verdict["reconcile"] = rec
+
+    verdict["pass"] = all(checks.values())
+    verdict["checks"] = checks
+    verdict["wall_s"] = round(time.perf_counter() - t0, 2)
+    return verdict
 
 
 FLIGHT_RUNNER_TEMPLATE = """\
@@ -351,8 +413,12 @@ def main(argv=None):
     keep = "--keep" in args
     names = [a for a in args if not a.startswith("--")] or \
         ["sweep", "plan", "serve", "flight"]
+    # lockwatch is invocable by name but NOT in the default set: it
+    # re-runs the serve child with tracing on — a diagnosis/CI drill,
+    # not part of the everyday all-drills sweep.
     drills = {"sweep": drill_sweep, "plan": drill_plan,
-              "serve": drill_serve, "flight": drill_flight}
+              "serve": drill_serve, "flight": drill_flight,
+              "lockwatch": drill_lockwatch}
     unknown = [n for n in names if n not in drills]
     if unknown:
         raise SystemExit(f"chaos_drill: unknown drill(s) {unknown}; "

@@ -12,7 +12,8 @@ NEW findings appear — the ratchet workflow for adopting a rule on a
 codebase with existing debt (PROFILE.md "Static analysis" > baseline
 workflow).
 
-``--pack NAME`` (jax | grid | obs | ir | engine) regenerates ONLY that
+``--pack NAME`` (jax | grid | obs | ir | concurrency | engine)
+regenerates ONLY that
 pack's section, preserving every other pack's fingerprints verbatim —
 the fix for the silent-drop bug: a full flat-list regeneration run
 before a new rule pack landed would re-record the whole world and, being

@@ -379,6 +379,25 @@ def chain():
             pass
     if not ok_c:
         log("chaos drills FAILED — continuing device chain (see log)")
+    # f16race runtime witness (ISSUE 17): the lockwatch drill re-runs
+    # the drain drill with lock tracing armed and reconciles the dynamic
+    # lock-order graph against the static C201 model. Same contract as
+    # chaos: evidence banked for the next session, never a chain gate —
+    # a reconciliation FAIL is a concurrency-model finding, not tunnel
+    # evidence. CPU-pinned by chaos_drill itself.
+    ok_lw, out_lw, _ = run_stage(
+        "lockwatch", [py, os.path.join(REPO, "tools", "chaos_drill.py"),
+                      "lockwatch", "--json"], 1800)
+    if out_lw and "{" in out_lw:
+        try:
+            rec = json.loads(out_lw[out_lw.index("{"):])
+            with open(os.path.join(REPO, "_scratch",
+                                   "lockwatch_drill.json"), "w") as fd:
+                json.dump(rec, fd, indent=1)
+        except (ValueError, OSError):
+            pass
+    if not ok_lw:
+        log("lockwatch drill FAILED — continuing device chain (see log)")
     # parity --full judges the hist (production) tier since ISSUE 9 —
     # the exact fallback tier no longer gates the headline record, so
     # parity runs BEFORE the exact-seed bank. The exact-tier sub-record
