@@ -1149,10 +1149,162 @@ def serve_bench():
     }))
 
 
+def fleet_bench(n_workers):
+    """bench.py --serve --fleet W: sustained throughput of a W-worker
+    serving fleet behind the health-gated router (ISSUE 18), plus a
+    live failover probe. Three phases, one JSON metric line:
+
+    1. single-worker reference: the in-process ScoringService under the
+       same load → ``single_rps`` (the scaling denominator);
+    2. fleet sustained load through serve/router.FleetRouter →
+       ``fleet_rps`` / ``fleet_p99_ms``;
+    3. failover probe: background load, SIGKILL one worker, measure the
+       router's orphan-re-dispatch window → ``fleet_failover_s``.
+
+    Scaling acceptance (W-worker fleet_rps >= 0.6 x W x single_rps) only
+    binds on a multi-core host; on 1 CPU the workers time-slice one
+    core, so the check passes vacuously with an explicit note — the
+    metrics are recorded either way."""
+    import signal
+    import tempfile
+    import threading
+
+    import jax
+
+    configure_jax_cache()
+
+    from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.obs.perfdb import knob_snapshot
+    from flake16_framework_tpu.serve.cli import sustained_load
+    from flake16_framework_tpu.serve.fleet import Fleet
+    from flake16_framework_tpu.serve.registry import ModelRegistry
+    from flake16_framework_tpu.serve.router import FleetRouter
+    from flake16_framework_tpu.serve.service import ScoringService
+
+    feats, labels, projects, names, pids = make_data(SERVE_N_TESTS)
+    workdir = tempfile.mkdtemp(prefix="f16-bench-fleet-")
+    registry = ModelRegistry(os.path.join(workdir, "registry"))
+    overrides = {"Extra Trees": SERVE_N_TREES,
+                 "Random Forest": SERVE_N_TREES}
+    t0 = time.time()
+    for keys in cfg.SHAP_CONFIGS:
+        registry.fit_and_register(keys, feats, labels,
+                                  max_depth=SERVE_MAX_DEPTH,
+                                  tree_overrides=overrides, persist=True)
+    t_fit = time.time() - t0
+
+    # Phase 1: the single-worker reference (in-process — the same
+    # service class the workers run, minus the wire).
+    with ScoringService(registry) as svc:
+        single = sustained_load(
+            svc, feats, registry.ids(), n_requests=SERVE_REQUESTS,
+            rows=SERVE_ROWS, kinds=("predict",), clients=SERVE_CLIENTS)
+    single_rps = single["rps"]
+
+    # Phases 2 + 3: the fleet.
+    t0 = time.time()
+    with Fleet(registry.root, n_workers, workdir=workdir) as fleet:
+        t_fleet_start = time.time() - t0
+        with FleetRouter(fleet) as router:
+            fleet_load = sustained_load(
+                router, feats, registry.ids(), n_requests=SERVE_REQUESTS,
+                rows=SERVE_ROWS, kinds=("predict",),
+                clients=SERVE_CLIENTS)
+
+            # Failover probe: steady background load so the victim has
+            # requests in flight when the SIGKILL lands.
+            stop_bg = threading.Event()
+            bg_errors = []
+
+            def _bg():
+                i = 0
+                mid = registry.ids()[0]
+                while not stop_bg.is_set():
+                    off = (i * SERVE_ROWS) % max(
+                        1, feats.shape[0] - SERVE_ROWS)
+                    try:
+                        router.score(mid, feats[off:off + SERVE_ROWS],
+                                     timeout=60.0)
+                    except Exception as e:
+                        bg_errors.append(repr(e))
+                    i += 1
+
+            bg = [threading.Thread(target=_bg, daemon=True)
+                  for _ in range(4)]
+            for t in bg:
+                t.start()
+            time.sleep(0.5)
+            victim = fleet.workers[0].pid
+            os.kill(victim, signal.SIGKILL)
+            probe_deadline = time.time() + 30.0
+            while router.last_failover_s is None \
+                    and time.time() < probe_deadline:
+                time.sleep(0.05)
+            time.sleep(0.5)  # a beat of post-failover traffic
+            stop_bg.set()
+            for t in bg:
+                t.join(10.0)
+            failover_s = router.last_failover_s
+            router_stats = router.stats()["router"]
+
+    n_cores = os.cpu_count() or 1
+    scaling_floor = 0.6 * n_workers * single_rps \
+        if single_rps else None
+    if n_cores <= 1:
+        scaling_ok = None
+        scaling_note = (f"1-core host: {n_workers} workers time-slice "
+                        "one CPU — scaling check vacuous "
+                        "(metrics recorded)")
+    elif scaling_floor is not None:
+        scaling_ok = bool(fleet_load["rps"] >= scaling_floor)
+        scaling_note = (f"{n_cores}-core host: fleet_rps "
+                        f"{fleet_load['rps']} vs floor "
+                        f"{round(scaling_floor, 2)} "
+                        f"(0.6 x {n_workers} x {single_rps})")
+    else:
+        scaling_ok, scaling_note = None, "no single-worker reference rps"
+
+    print(json.dumps({
+        "metric": "fleet_sustained_rps",
+        "value": fleet_load["rps"],
+        "unit": "req_per_s",
+        "vs_baseline": None,
+        "detail": {
+            "fleet_rps": fleet_load["rps"],
+            "fleet_p99_ms": fleet_load["p99_ms"],
+            "fleet_p50_ms": fleet_load["p50_ms"],
+            "fleet_failover_s": failover_s,
+            "fleet_workers": n_workers,
+            "single_rps": single_rps,
+            "single_p99_ms": single["p99_ms"],
+            "scaling_ok": scaling_ok,
+            "scaling_note": scaling_note,
+            "n_cores": n_cores,
+            "requests": fleet_load["requests"],
+            "rows": SERVE_ROWS,
+            "clients": SERVE_CLIENTS,
+            "n_errors": fleet_load["n_errors"],
+            "bg_probe_errors": len(bg_errors),
+            "router": router_stats,
+            "fit_s": round(t_fit, 2),
+            "fleet_start_s": round(t_fleet_start, 2),
+            "n_tests": SERVE_N_TESTS,
+            "n_trees": SERVE_N_TREES,
+            "backend": jax.default_backend(),
+            "knobs": knob_snapshot(),
+        },
+    }))
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         worker(int(sys.argv[2]), int(sys.argv[3]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--serve":
-        serve_bench()
+        if "--fleet" in sys.argv:
+            w = sys.argv.index("--fleet")
+            fleet_bench(int(sys.argv[w + 1])
+                        if len(sys.argv) > w + 1 else 3)
+        else:
+            serve_bench()
     else:
         main()

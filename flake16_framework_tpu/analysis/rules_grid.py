@@ -130,6 +130,17 @@ KNOBS = {
     # arms the tracer and dumps lockwatch.json to the CWD at exit; any
     # other non-empty value is the dump path; ""/"0" leaves it off.
     "F16_LOCKWATCH": ("str", None),
+    # Serving fleet (ISSUE 18). F16_FLEET_WORKER: set by the fleet
+    # supervisor in each worker's env to its index — consumed by
+    # serve/fleet.py (worker identity) and obs/flight.py (per-worker
+    # ring-path uniquification); never set by hand. The rest tune the
+    # router: hedge delay before a second dispatch of a slow request,
+    # worker heartbeat period, and the heartbeat-staleness bound past
+    # which a worker is routed around as stalled.
+    "F16_FLEET_WORKER": ("str", None),
+    "F16_FLEET_HEDGE_MS": ("float", 0.0),
+    "F16_FLEET_HEARTBEAT_S": ("float", 0.0),
+    "F16_FLEET_STALL_S": ("float", 0.0),
 }
 
 # The PAPER's grid size — historical reference only. The pre-flight's

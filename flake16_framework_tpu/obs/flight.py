@@ -283,11 +283,93 @@ def env_path(environ=None, run_dir=None):
     """The armed flight-ring path from ``F16_FLIGHT`` (None = off).
     ``1`` means ``<run_dir>/flight.bin`` — only resolvable with an
     active run; an explicit value is the path itself (the form the
-    supervisor can dump)."""
+    supervisor can dump).
+
+    Under a serving fleet (ISSUE 18) every worker inherits the SAME
+    ``F16_FLIGHT`` value from the fleet manager — without
+    uniquification W workers would mmap one ring file and clobber each
+    other's headers. When ``F16_FLEET_WORKER`` is present the path
+    gains a ``.w<index>`` suffix before the extension
+    (``flight.bin`` → ``flight.w2.bin``); the fleet manager computes
+    the identical path with the worker's env to dump the corpse ring,
+    and ``replay_dir`` merges a directory of per-worker rings."""
     env = os.environ if environ is None else environ
     raw = env.get("F16_FLIGHT", "")
     if not raw:
         return None
     if raw == "1":
-        return os.path.join(run_dir, "flight.bin") if run_dir else None
-    return raw
+        if not run_dir:
+            return None
+        path = os.path.join(run_dir, "flight.bin")
+    else:
+        path = raw
+    worker = env.get("F16_FLEET_WORKER", "")
+    if worker != "":
+        stem, ext = os.path.splitext(path)
+        path = f"{stem}.w{worker}{ext or '.bin'}"
+    return path
+
+
+def replay_dir(dirpath):
+    """(records, metas) merged by timestamp over every flight ring in a
+    directory — the fleet form of ``replay`` (one ring per worker; the
+    merged stream is the fleet's interleaved last seconds). Non-ring
+    files are skipped; per-ring metas carry each ring's path + torn
+    flag plus the source count."""
+    records = []
+    metas = []
+    for name in sorted(os.listdir(dirpath)):
+        if not name.endswith(".bin"):
+            continue
+        path = os.path.join(dirpath, name)
+        try:
+            recs, meta = replay(path)
+        except (OSError, ValueError):
+            continue
+        meta = dict(meta, path=path)
+        metas.append(meta)
+        records.extend(recs)
+    records.sort(key=lambda ev: ev.get("ts") or 0.0)
+    return records, {"rings": metas, "n": len(records),
+                     "torn": any(m["torn"] for m in metas)}
+
+
+def dump_dir(dirpath, out=None, last=60, flush_manifest=True):
+    """Replay + pretty-print a DIRECTORY of flight rings merged by
+    timestamp (``report --flight <dir>`` under a fleet). Same contract
+    as ``dump``: never raises on torn tails, writes the merged replay
+    as ``<dir>/flight.merged.dump.json``."""
+    from flake16_framework_tpu.obs import core
+    from flake16_framework_tpu.utils.atomic import atomic_write
+
+    out = out or sys.stdout
+    records, meta = replay_dir(dirpath)
+    core.event("flight", action="dump-dir", path=str(dirpath),
+               rings=len(meta["rings"]), n=meta["n"], torn=meta["torn"])
+    out.write(f"flight dir {dirpath}: {len(meta['rings'])} ring(s), "
+              f"{meta['n']} record(s) merged by timestamp"
+              + (" — TORN tail(s)\n" if meta["torn"] else "\n"))
+    for ring in meta["rings"]:
+        out.write(f"  ring {ring['path']}: {ring['n']} record(s)"
+                  + (" TORN" if ring["torn"] else "") + "\n")
+    gauges = last_gauges(records)
+    if gauges:
+        out.write("final gauges: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(gauges.items())) + "\n")
+    for ev in records[-last:]:
+        ts = ev.get("ts")
+        stamp = time.strftime("%H:%M:%S", time.localtime(ts)) \
+            if isinstance(ts, (int, float)) else "?"
+        fields = {k: v for k, v in ev.items()
+                  if k not in ("kind", "ts", "run")}
+        out.write(f"  {stamp} {ev.get('kind', '?'):<10} "
+                  + " ".join(f"{k}={v}" for k, v in fields.items())[:160]
+                  + "\n")
+    dump_path = os.path.join(dirpath, "flight.merged.dump.json")
+    with atomic_write(dump_path, "w") as fd:
+        json.dump({"meta": meta, "gauges": gauges, "records": records},
+                  fd, indent=1, default=str)
+    out.write(f"wrote {dump_path}\n")
+    if flush_manifest:
+        flush_gauges_to_manifest(records, out=out)
+    return records, meta

@@ -35,6 +35,8 @@ the tuning database ROADMAP item 3's autotuner will write into
 (``record_tuned``).
 """
 
+import contextlib
+import fcntl
 import hashlib
 import json
 import os
@@ -50,11 +52,32 @@ DB_FILE = os.path.join("_scratch", "perfdb.jsonl")
 # Serializes IN-PROCESS appenders (bench rounds and run ingestion can
 # share a process with serve's drain flush): recover->dedup->append must
 # be atomic or two appenders double-write the same identity (f16race
-# dogfood). CROSS-process writers stay single-writer by contract — the
-# CLI and bench own the db path for the duration of a run — and a
-# crashed writer's torn tail is healed by ``recover`` on the next
-# append, not by locking.
+# dogfood). CROSS-process appenders (ISSUE 18: a W-worker serving fleet
+# means W drain flushes can ingest into one db path) are serialized by
+# an ``fcntl`` lock on ``<path>.lock`` — see ``_file_lock`` — so the
+# recover->dedup->append window is atomic fleet-wide, not just
+# process-wide. A crashed writer's torn tail is still healed by
+# ``recover`` on the next append; the flock is released by the kernel
+# when the holder dies, so a crash never wedges the db.
 _append_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def _file_lock(path):
+    """Exclusive ``fcntl.flock`` on ``path + ".lock"`` (a sidecar, so the
+    db file itself can be atomically recovered/truncated under the lock
+    without disturbing the lock inode). Blocks until acquired; released
+    on exit and — because flocks die with their holder — on crash."""
+    lock_path = path + ".lock"
+    fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        yield
+    finally:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        finally:
+            os.close(fd)
 
 # Repo root (committed BENCH_rNN.json live beside the package dir).
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -215,14 +238,19 @@ def recover(path):
 
 def append(rows, path=None):
     """Append rows not already present (by ``row_identity``), after
-    recovering any torn tail. Returns the number written."""
+    recovering any torn tail. Returns the number written.
+
+    Safe under concurrent appenders — same-process writers serialize on
+    ``_append_lock``, other processes on the ``fcntl`` sidecar lock — so
+    fleet workers ingesting into a shared db path cannot double-write an
+    identity or interleave a recover with another's append."""
     path = default_db(path)
     if path is None:
         return 0
     parent = os.path.dirname(path)
     if parent:
         os.makedirs(parent, exist_ok=True)
-    with _append_lock:
+    with _append_lock, _file_lock(path):
         recover(path)
         seen = {row_identity(r) for r in load(path)}
         n = 0

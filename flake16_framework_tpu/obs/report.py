@@ -431,12 +431,30 @@ def report_main(args, out=None):
             raise ValueError(f"Unrecognized report argument {a!r}")
     if flight:
         # ``report --flight [PATH]``: PATH may be the ring file itself
-        # (the supervisor's explicit-path form) or a run dir holding
-        # flight.bin; default = the latest run's ring.
+        # (the supervisor's explicit-path form), a directory of
+        # per-worker rings (the fleet form — merged by timestamp), or a
+        # run dir holding flight.bin; default = the latest run's ring.
         from flake16_framework_tpu.obs import flight as _flight
 
+        rings = [n for n in os.listdir(path)
+                 if n.endswith(".bin")] \
+            if path is not None and os.path.isdir(path) else []
         if path is not None and os.path.isfile(path):
             ring = path
+        elif len(rings) > 1 or any(
+                os.path.splitext(n)[0].rpartition(".")[2].startswith("w")
+                and n != "flight.bin" for n in rings):
+            # Multiple rings, or per-worker ``.w<i>`` suffixed rings: a
+            # fleet workdir — merge. A run dir's single flight.bin
+            # keeps the classic single-ring path below.
+            records, meta = _flight.dump_dir(path, out=out,
+                                             flush_manifest=False)
+            if as_json:
+                out.write(json.dumps(
+                    {"meta": meta,
+                     "gauges": _flight.last_gauges(records)},
+                    indent=1, default=str) + "\n")
+            return {"meta": meta, "records": records}
         else:
             ring = os.path.join(find_run_dir(path, root), "flight.bin")
         if not os.path.isfile(ring):

@@ -88,6 +88,12 @@ EVENT_FIELDS = {
     # ``phase`` is begin | complete | abort. Complete/abort carry the
     # accounting fields ``completed``/``rejected``/``aborted``.
     "drain": {"phase": str},
+    # Serving-fleet lifecycle (ISSUE 18; serve/fleet.py supervisor and
+    # serve/router.py): ``action`` is restart | budget-exhausted |
+    # respawn-drained | failed (supervisor, with ``rc``/``restarts``
+    # context) or link-down | rolling-drain | rolling-done (router);
+    # ``worker`` is the fleet index the transition concerns.
+    "fleet": {"action": str, "worker": int},
     # Supervisor child restart (resilience/supervisor.py): ``attempt`` is
     # the 1-based restart number; extra fields ``rc`` (the death the
     # restart answers, negative = killed by that signal) and ``budget``.

@@ -39,6 +39,18 @@ entries are invisible to the dispatch guard — ``check`` skips them, so
 retry/degrade/quarantine semantics are untouched — and the supervisor
 strips them from the child environment on restart so each injected kill
 fires exactly once.
+
+ISSUE 18 adds the FLEET WORKER classes ``worker-kill`` and
+``worker-stall`` for the serving-fleet chaos drills. A worker entry
+reads ``<worker>:<request#>:worker-kill``: the first field addresses a
+worker index (the value of ``F16_FLEET_WORKER`` in that worker's
+environment, or ``*``), the second the 1-based score request at which
+the fault fires. ``worker-kill`` SIGKILLs the worker with requests in
+flight (the router-failover drill); ``worker-stall`` freezes the worker
+— heartbeats stop, accepted requests never answer — so the router's
+staleness gate and hedging have a deterministic straggler to route
+around. Worker entries are skipped by ``check`` and
+``process_signal`` and stripped on restart like process entries.
 """
 
 import os
@@ -54,6 +66,16 @@ PROCESS_CLASSES = {
     "sigkill": _signal.SIGKILL,
     "sigterm": _signal.SIGTERM,
 }
+
+# Fleet worker classes (ISSUE 18): consumed by serve/fleet.py's worker
+# loop, not the journal. An entry reads <worker>:<request#>:worker-kill —
+# the FIRST field addresses the worker index (F16_FLEET_WORKER), the
+# second the 1-based score request at which the fault fires.
+# ``worker-kill`` SIGKILLs the worker mid-service (the failover drill);
+# ``worker-stall`` wedges its reader loop and stops heartbeats (the
+# stalled-worker health-gating drill). Like process entries, they are
+# invisible to the dispatch guard and stripped on supervised restart.
+WORKER_CLASSES = ("worker-kill", "worker-stall")
 
 _CLASS_ALIASES = {
     "transient": faults.TRANSIENT_DEVICE,
@@ -88,9 +110,10 @@ class FaultPlan:
         """Raise InjectedFault when the plan schedules a fault for this
         (config, attempt) dispatch; no-op otherwise. Process entries
         (sigkill/sigterm) are NOT the guard's to deliver — they belong to
-        the journal's fold-append points — so they are skipped here."""
+        the journal's fold-append points — and worker entries belong to
+        the fleet worker loop, so both are skipped here."""
         for k, j, fc in self.entries:
-            if fc in PROCESS_CLASSES:
+            if fc in PROCESS_CLASSES or fc in WORKER_CLASSES:
                 continue
             if (k is None or k == config_index) and \
                     (j is None or j == attempt):
@@ -112,6 +135,23 @@ class FaultPlan:
             if (k is None or k == config_index) and \
                     (j is None or j == fold):
                 return PROCESS_CLASSES[fc]
+        return None
+
+    def worker_entries(self):
+        """The (worker_index, request_1based, class_name) fleet-worker
+        entries — the fleet chaos subset of the plan."""
+        return tuple((k, j, fc) for k, j, fc in self.entries
+                     if fc in WORKER_CLASSES)
+
+    def worker_action(self, worker_index, request_no):
+        """The worker fault class ("worker-kill"/"worker-stall")
+        scheduled for this worker's 1-based ``request_no`` score request,
+        or None. Consulted by the fleet worker loop BEFORE submitting the
+        request to its service."""
+        for k, j, fc in self.worker_entries():
+            if (k is None or k == worker_index) and \
+                    (j is None or j == request_no):
+                return fc
         return None
 
 
@@ -138,22 +178,24 @@ def parse_plan(spec):
         if j is not None and j < 1:
             raise ValueError(
                 f"{ENV_VAR} entry {raw!r}: attempts/folds are 1-based")
-        if fc_s in PROCESS_CLASSES:
+        if fc_s in PROCESS_CLASSES or fc_s in WORKER_CLASSES:
             fc = fc_s
         else:
             fc = _CLASS_ALIASES.get(fc_s)
         if fc is None:
+            known = sorted(set(_CLASS_ALIASES) | set(PROCESS_CLASSES)
+                           | set(WORKER_CLASSES))
             raise ValueError(
                 f"{ENV_VAR} entry {raw!r}: unknown fault class {fc_s!r} "
-                f"(want one of "
-                f"{sorted(set(_CLASS_ALIASES) | set(PROCESS_CLASSES))})")
+                f"(want one of {known})")
         entries.append((k, j, fc))
     return FaultPlan(entries)
 
 
 def strip_process_entries(spec):
-    """``spec`` minus its process (sigkill/sigterm) entries — what the
-    supervisor exports to a restarted child so an injected kill fires
+    """``spec`` minus its process (sigkill/sigterm) AND fleet worker
+    (worker-kill/worker-stall) entries — what the supervisor and the
+    fleet manager export to a restarted child so an injected fault fires
     exactly once. Returns "" when nothing survives."""
     kept = []
     for raw in spec.split(";"):
@@ -161,7 +203,8 @@ def strip_process_entries(spec):
         if not raw:
             continue
         parts = [p.strip() for p in raw.split(":")]
-        if len(parts) == 3 and parts[2] in PROCESS_CLASSES:
+        if len(parts) == 3 and (parts[2] in PROCESS_CLASSES
+                                or parts[2] in WORKER_CLASSES):
             continue
         kept.append(raw)
     return ";".join(kept)

@@ -8,6 +8,8 @@ closed-loop client load, print one JSON stats line.
         [--registry DIR] [--json]
         [--hold] [--hold-timeout S] [--drain-deadline S]
         [--metrics-port P] [--slo] [--slo-p99-ms MS]
+        [--fleet W] [--workdir DIR] [--rolling-restart]
+        [--worker --socket PATH]
 
 ``--metrics-port P`` stands the Prometheus exporter up on loopback port
 P (0 = ephemeral; the bound port prints as ``METRICS_PORT <p>``), and —
@@ -29,6 +31,16 @@ closed-loop load until SIGTERM (or ``--hold-timeout``), then
 Exit 0 iff the drain completed within the deadline and every client
 request was accounted for (completed, or retriably rejected) — zero
 silent drops. ``tools/chaos_drill.py serve`` is the parent half.
+
+ISSUE 18 adds the fleet modes. ``--fleet W`` fits + persists the
+registry, spawns W worker processes (serve/fleet.Fleet) over it,
+stands the health-gated hedging router up (serve/router.FleetRouter),
+and drives the SAME ``sustained_load`` through the router — the fleet
+is a drop-in ScoringService from the driver's side. With
+``--rolling-restart`` the load is followed by a zero-drop rolling
+restart walk. ``--worker --socket PATH --registry DIR`` is the child
+half the fleet spawns: load the persisted registry (no fitting), warm,
+answer wire-protocol frames until drained.
 """
 
 import json
@@ -154,6 +166,8 @@ def _parse(args):
         "registry": None, "json": False,
         "hold": False, "hold_timeout": 120.0, "drain_deadline": 10.0,
         "metrics_port": None, "slo": False, "slo_p99_ms": 50.0,
+        "worker": False, "socket": None,
+        "fleet": None, "workdir": None, "rolling_restart": False,
     }
     it = iter(args)
     for a in it:
@@ -163,17 +177,25 @@ def _parse(args):
             opts["hold"] = True
         elif a == "--slo":
             opts["slo"] = True
+        elif a == "--worker":
+            opts["worker"] = True
+        elif a == "--rolling-restart":
+            opts["rolling_restart"] = True
         elif a in ("--hold-timeout", "--drain-deadline", "--slo-p99-ms"):
             opts[a[2:].replace("-", "_")] = float(next(it))
         elif a == "--metrics-port":
             opts["metrics_port"] = int(next(it))
         elif a in ("--synth", "--trees", "--max-depth", "--limit",
-                   "--requests", "--rows", "--clients"):
+                   "--requests", "--rows", "--clients", "--fleet"):
             opts[a[2:].replace("-", "_")] = int(next(it))
         elif a == "--ledger":
             opts["ledger"] = next(it)
         elif a == "--registry":
             opts["registry"] = next(it)
+        elif a == "--socket":
+            opts["socket"] = next(it)
+        elif a == "--workdir":
+            opts["workdir"] = next(it)
         elif a == "--kinds":
             opts["kinds"] = tuple(next(it).split(","))
         elif a == "--buckets":
@@ -183,8 +205,52 @@ def _parse(args):
     return opts
 
 
+def _fleet_main(opts, feats, registry):
+    """The ``--fleet W`` body: spawn the worker fleet over the persisted
+    registry, route the sustained load through the hedging router, then
+    (optionally) walk a zero-drop rolling restart."""
+    import os
+    import tempfile
+
+    from flake16_framework_tpu.serve.fleet import Fleet
+    from flake16_framework_tpu.serve.router import FleetRouter
+
+    workdir = opts["workdir"] or tempfile.mkdtemp(prefix="f16-fleet-")
+    os.makedirs(workdir, exist_ok=True)
+    slo_p99 = opts["slo_p99_ms"] if opts["slo"] else None
+    with Fleet(registry.root, opts["fleet"], workdir=workdir,
+               buckets=opts["buckets"], slo_p99_ms=slo_p99) as fleet:
+        with FleetRouter(fleet) as router:
+            result = sustained_load(
+                router, feats, registry.ids(),
+                n_requests=opts["requests"], rows=opts["rows"],
+                kinds=opts["kinds"], clients=opts["clients"])
+            if opts["rolling_restart"]:
+                result["rolling_restart"] = router.rolling_restart(
+                    drain_deadline_s=opts["drain_deadline"])
+            stats = router.stats()
+            result["fleet"] = {
+                "workers": opts["fleet"],
+                "pids": fleet.pids(),
+                "router": stats["router"],
+                "failover_s": router.last_failover_s,
+                "per_worker": [w["hb"].get("requests")
+                               for w in stats["workers"]],
+            }
+    result["models"] = registry.ids()
+    print(json.dumps(result) if opts["json"]
+          else json.dumps(result, indent=1))
+    sys.stdout.flush()
+    return 1 if result["n_errors"] else 0
+
+
 def serve_main(args):
     opts = _parse(args)
+
+    if opts["worker"]:
+        from flake16_framework_tpu.serve.fleet import worker_main
+
+        return worker_main(opts)
 
     from flake16_framework_tpu import config as cfg
     from flake16_framework_tpu.serve.registry import ModelRegistry
@@ -192,6 +258,16 @@ def serve_main(args):
     from flake16_framework_tpu.utils import synth
 
     feats, labels, _ = synth.make_dataset(n_tests=opts["synth"], seed=7)
+
+    if opts["fleet"] and not opts["registry"]:
+        # Workers load artifacts from disk — a fleet NEEDS a persisted
+        # registry; default one under the (possibly ephemeral) workdir.
+        import os as _os
+        import tempfile as _tempfile
+
+        opts["workdir"] = (opts["workdir"]
+                           or _tempfile.mkdtemp(prefix="f16-fleet-"))
+        opts["registry"] = _os.path.join(opts["workdir"], "registry")
 
     persist = opts["registry"] is not None
     registry = ModelRegistry(opts["registry"] or "serve-registry")
@@ -207,6 +283,9 @@ def serve_main(args):
             registry.fit_and_register(
                 keys, feats, labels, max_depth=opts["max_depth"],
                 tree_overrides=overrides, persist=persist)
+
+    if opts["fleet"]:
+        return _fleet_main(opts, feats, registry)
 
     slo_cfg = None
     if opts["slo"] or opts["metrics_port"] is not None:

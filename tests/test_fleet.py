@@ -1,0 +1,335 @@
+"""Serving-fleet tests (serve/wire.py, serve/fleet.py, serve/router.py —
+ISSUE 18) — all CPU, tiny models, real worker processes.
+
+The acceptance drills live here in miniature: the wire codec round-trip
+(numpy arrays survive the frame), the worker fault-class grammar
+(``<worker>:<request#>:worker-kill|worker-stall``), and the fleet
+end-to-end — 2 real worker processes behind the health-gated router,
+SIGKILL one under load (zero client-visible errors, failover window
+closed, supervisor respawn on budget), then a zero-drop rolling
+restart. Plus the cross-process satellites: the perfdb fcntl append
+lock under multiprocess contention, two processes sharing one
+persisted registry + AOT store, and the flight recorder's per-worker
+ring uniquification with the directory merge ``report --flight`` takes
+over a fleet's rings.
+
+The full-size failover/rolling drill (3 workers, sustained load) is
+tools/chaos_drill.py ``fleet``; these tests keep the fleet at 2 workers
+and bounded request counts so tier-1 stays inside its budget.
+"""
+
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from flake16_framework_tpu import config as cfg  # noqa: E402
+from flake16_framework_tpu.obs import flight, perfdb  # noqa: E402
+from flake16_framework_tpu.resilience import inject  # noqa: E402
+from flake16_framework_tpu.serve import wire  # noqa: E402
+from flake16_framework_tpu.serve.fleet import Fleet  # noqa: E402
+from flake16_framework_tpu.serve.registry import ModelRegistry  # noqa: E402
+from flake16_framework_tpu.serve.router import (  # noqa: E402
+    FleetRouter, NoRoutableWorker,
+)
+from flake16_framework_tpu.utils.synth import make_dataset  # noqa: E402
+
+DT_CONFIG = ("NOD", "Flake16", "None", "None", "Decision Tree")
+TINY = {"Extra Trees": 4, "Random Forest": 4}
+MAX_DEPTH = 6
+BUCKETS = (4, 16)
+
+
+@pytest.fixture(scope="module")
+def data():
+    feats, labels, _ = make_dataset(n_tests=160, seed=7)
+    return np.asarray(feats), labels
+
+
+@pytest.fixture(scope="module")
+def fleet_registry(data, tmp_path_factory):
+    """A PERSISTED single-model registry — what fleet workers load from
+    disk (no fitting in a worker)."""
+    feats, labels = data
+    root = str(tmp_path_factory.mktemp("fleet-registry"))
+    reg = ModelRegistry(root)
+    reg.fit_and_register(DT_CONFIG, feats, labels, max_depth=MAX_DEPTH,
+                         tree_overrides=TINY, seed=3, persist=True)
+    return root, reg.ids()[0]
+
+
+# -- wire codec ---------------------------------------------------------
+
+
+def test_wire_roundtrip_arrays():
+    msg = {"id": 7, "op": "score",
+           "x": np.arange(12, dtype=np.float32).reshape(3, 4),
+           "nested": {"y": np.array([1, 2, 3], dtype=np.int32)},
+           "plain": [1, 2.5, "s", None]}
+    back = wire.unpack_payload(wire.pack(msg)[4:])
+    assert back["id"] == 7 and back["plain"] == [1, 2.5, "s", None]
+    assert back["x"].dtype == np.float32
+    np.testing.assert_array_equal(back["x"], msg["x"])
+    np.testing.assert_array_equal(back["nested"]["y"], msg["nested"]["y"])
+
+
+def test_wire_socket_send_recv_and_eof():
+    a, b = socket.socketpair()
+    try:
+        wire.send_msg(a, {"id": 1, "x": np.ones(3)})
+        got = wire.recv_msg(b)
+        assert got["id"] == 1 and got["x"].shape == (3,)
+        a.close()
+        assert wire.recv_msg(b) is None  # clean EOF, not an error
+    finally:
+        b.close()
+
+
+def test_wire_torn_frame_raises():
+    a, b = socket.socketpair()
+    try:
+        # A length prefix promising more bytes than ever arrive: EOF
+        # mid-frame is a WireError (torn peer), never a silent None.
+        a.sendall(struct.pack(">I", 64) + b"half")
+        a.close()
+        with pytest.raises(wire.WireError):
+            wire.recv_msg(b)
+    finally:
+        b.close()
+
+
+def test_wire_oversized_frame_rejected():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack(">I", wire.MAX_FRAME + 1))
+        with pytest.raises(wire.WireError):
+            wire.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# -- worker fault-class grammar (resilience/inject.py) ------------------
+
+
+def test_inject_worker_class_parsing():
+    plan = inject.parse_plan("0:3:worker-kill;1:2:worker-stall")
+    assert plan.worker_action(0, 3) == "worker-kill"
+    assert plan.worker_action(0, 2) is None
+    assert plan.worker_action(1, 2) == "worker-stall"
+    assert plan.worker_action(2, 1) is None
+    # worker entries never fire through the in-process guard check
+    assert plan.check(0, 3) is None
+    with pytest.raises(ValueError):
+        inject.parse_plan("0:1:worker-explode")
+
+
+def test_inject_strip_removes_worker_entries():
+    spec = "0:1:worker-kill;2:5:oom;1:1:sigkill"
+    assert inject.strip_process_entries(spec) == "2:5:oom"
+
+
+# -- flight-ring uniquification + directory merge -----------------------
+
+
+def test_flight_env_path_worker_suffix(tmp_path):
+    base = str(tmp_path / "flight.bin")
+    env = {"F16_FLIGHT": base}
+    assert flight.env_path(environ=env) == base
+    env["F16_FLEET_WORKER"] = "2"
+    assert flight.env_path(environ=env) == str(tmp_path / "flight.w2.bin")
+    # the "1" form uniquifies the run-dir ring the same way
+    env["F16_FLIGHT"] = "1"
+    assert flight.env_path(environ=env, run_dir=str(tmp_path)) == \
+        str(tmp_path / "flight.w2.bin")
+
+
+def test_flight_replay_dir_merges_by_timestamp(tmp_path):
+    for w, ts0 in ((0, 100.0), (1, 100.5)):
+        rec = flight.FlightRecorder(str(tmp_path / f"flight.w{w}.bin"))
+        for i in range(5):
+            rec.record({"kind": "gauge", "ts": ts0 + i,
+                        "name": f"w{w}.seq", "value": i})
+        rec.close()
+    records, meta = flight.replay_dir(str(tmp_path))
+    assert meta["n"] == 10 and len(meta["rings"]) == 2
+    assert not meta["torn"]
+    stamps = [r["ts"] for r in records]
+    assert stamps == sorted(stamps)  # interleaved, globally ordered
+    # dump_dir writes the merged forensics document
+    with open(os.devnull, "w") as sink:
+        flight.dump_dir(str(tmp_path), out=sink, flush_manifest=False)
+    merged = json.load(open(tmp_path / "flight.merged.dump.json"))
+    assert merged["meta"]["n"] == 10 and len(merged["records"]) == 10
+
+
+# -- perfdb multiprocess contention (the fcntl append lock) -------------
+
+
+_PERFDB_WRITER = """\
+import sys
+sys.path.insert(0, {repo!r})
+from flake16_framework_tpu.obs import perfdb
+db, wid = sys.argv[1], int(sys.argv[2])
+for i in range(15):
+    mine = perfdb.make_row("cpu", "s%d" % i, "k%d" % wid,
+                           {{"wall_s": 0.1 + i}}, src="w%d" % wid, ts=1.0)
+    shared = perfdb.make_row("cpu", "shared", "kS", {{"wall_s": 1.0}},
+                             src="shared", ts=123.0)
+    perfdb.append([mine, shared], db)
+"""
+
+
+def test_perfdb_multiprocess_append_contention(tmp_path):
+    """3 processes hammer one db — every row lands exactly once: the
+    fcntl sidecar lock makes recover->dedup->append atomic fleet-wide
+    (without it the shared row double-writes and tails interleave)."""
+    db = str(tmp_path / "perfdb.jsonl")
+    script = _PERFDB_WRITER.format(repo=REPO)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen([sys.executable, "-c", script, db, str(w)],
+                              env=env) for w in range(3)]
+    assert [p.wait(timeout=120) for p in procs] == [0, 0, 0]
+    rows = perfdb.load(db)
+    ids = [perfdb.row_identity(r) for r in rows]
+    assert len(ids) == len(set(ids))          # no duplicate identities
+    assert len(rows) == 3 * 15 + 1            # per-writer rows + shared
+    assert os.path.exists(db + ".lock")
+
+
+# -- two processes over one persisted registry + AOT store --------------
+
+
+_STORE_READER = """\
+import json, sys
+sys.path.insert(0, {repo!r})
+from flake16_framework_tpu.serve.registry import ModelRegistry
+from flake16_framework_tpu.serve.store import ExecutableStore
+reg = ModelRegistry(sys.argv[1])
+reg.load()
+store = ExecutableStore(reg)
+manifest = store.warm_manifest(reg.models(), {buckets!r})
+print(json.dumps({{"ids": sorted(reg.ids()), "manifest": manifest}}))
+"""
+
+
+def test_registry_store_concurrent_two_process(fleet_registry):
+    """Two processes load the SAME persisted registry dir and warm the
+    SAME AOT store concurrently — the fleet's worker startup pattern.
+    Both must succeed with identical model ids and identical executable
+    signature digests (shared on-disk artifacts, no cross-talk)."""
+    reg_dir, model_id = fleet_registry
+    script = _STORE_READER.format(repo=REPO, buckets=BUCKETS)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen([sys.executable, "-c", script, reg_dir],
+                              stdout=subprocess.PIPE, text=True, env=env)
+             for _ in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        assert p.returncode == 0
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    assert outs[0] == outs[1]
+    assert model_id in outs[0]["ids"]
+
+
+# -- fleet end-to-end ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_pair(fleet_registry, tmp_path_factory):
+    reg_dir, model_id = fleet_registry
+    work = str(tmp_path_factory.mktemp("fleet-work"))
+    with Fleet(reg_dir, 2, workdir=work, buckets=BUCKETS) as fleet:
+        with FleetRouter(fleet, hedge_ms=300.0) as router:
+            yield fleet, router, model_id
+
+
+def test_fleet_scores_and_stats(fleet_pair, data):
+    fleet, router, model_id = fleet_pair
+    feats, _ = data
+    out = router.score(model_id, feats[:4], timeout=60)
+    out2 = router.score(model_id, feats[:4], timeout=60)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    stats = router.stats()
+    assert len(stats["workers"]) == 2
+    assert stats["router"]["completed"] >= 2
+    assert stats["requests"] >= 2
+
+
+def test_fleet_kill_failover_and_rolling_restart(fleet_pair, data):
+    """SIGKILL worker 0 mid-sequence: every request still completes
+    (orphans fail OVER through the repair queue), the failover window
+    closes, the supervisor respawns on budget — then a rolling restart
+    cycles both workers with zero errors and all-new pids."""
+    fleet, router, model_id = fleet_pair
+    feats, _ = data
+    victim = fleet.workers[0]
+    old_pid = victim.pid
+    os.kill(old_pid, signal.SIGKILL)
+    for i in range(10):
+        router.score(model_id, feats[i:i + 4], timeout=60)
+    assert router.last_failover_s is None or router.last_failover_s < 30
+    # supervisor respawn: new pid, restart budget charged, not failed
+    deadline = time.monotonic() + 120
+    while (victim.pid == old_pid or not victim.alive()) \
+            and time.monotonic() < deadline:
+        time.sleep(0.2)
+    assert victim.pid != old_pid and victim.alive()
+    assert victim.restarts == 1 and not victim.failed
+    fleet.wait_ready([0], timeout_s=120)
+
+    pids_before = fleet.pids()
+    rolling = router.rolling_restart(drain_deadline_s=15,
+                                     ready_timeout_s=180)
+    assert len(rolling["steps"]) == 2
+    assert not (set(fleet.pids()) & set(pids_before))
+    for i in range(6):
+        router.score(model_id, feats[i:i + 4], timeout=60)
+
+
+def test_fleet_worker_stall_gated_and_hedged(fleet_registry,
+                                             tmp_path_factory, data):
+    """``0:1:worker-stall``: worker 0 swallows its first score request
+    and stops heartbeating. The router's hedge covers the swallowed
+    request on worker 1 and the staleness gate routes around the
+    stalled worker — the client sees answers, never a hang."""
+    reg_dir, model_id = fleet_registry
+    feats, _ = data
+    work = str(tmp_path_factory.mktemp("fleet-stall"))
+    env = dict(os.environ)
+    env[inject.ENV_VAR] = "0:1:worker-stall"
+    with Fleet(reg_dir, 2, workdir=work, buckets=BUCKETS,
+               env=env) as fleet:
+        with FleetRouter(fleet, hedge_ms=150.0, stall_s=1.0) as router:
+            for i in range(6):
+                out = router.score(model_id, feats[i:i + 4], timeout=60)
+                assert np.asarray(out).shape[0] >= 1
+            # the stalled worker is gated off routing once its
+            # heartbeat goes stale
+            time.sleep(1.5)
+            stalled = [w for w in router.links if not w.routable(1.0)]
+            assert any(w.index == 0 for w in stalled)
+
+
+def test_no_routable_worker_is_retriable(tmp_path):
+    """A router with only dead sockets fails fast with the RETRIABLE
+    rejection — a client may resubmit, nothing was dispatched."""
+    router = FleetRouter(socket_paths=[str(tmp_path / "w0.sock")],
+                         max_attempts=1)
+    router.start()
+    try:
+        req = router.submit("m", np.zeros((1, 4)))
+        with pytest.raises(NoRoutableWorker):
+            req.result(timeout=30)
+    finally:
+        router.stop()

@@ -56,7 +56,7 @@ PER_CONFIG_CEIL = 2.0
 # rule below); t_ours_fit_s is present in r05's detail, so a fit-wall
 # blowup vs the last comparable round fails the gate from round 7 on.
 HIGHER_BETTER = ("value", "scores_speedup", "shap_speedup", "serve_rps",
-                 "fit_gflops")
+                 "fit_gflops", "fleet_rps")
 # grid_dispatch_count (round 8+, the ISSUE-12 engine-tax census): fresh
 # XLA dispatches for a whole-216-grid planner scores run — an integer
 # structural property (#plans), so any growth is a real engine
@@ -71,10 +71,15 @@ HIGHER_BETTER = ("value", "scores_speedup", "shap_speedup", "serve_rps",
 # during the bench load — sustained shedding on the reference workload
 # is an SLO regression. Absent from rounds <= r09, hence vacuous
 # against them.
+# fleet_rps / fleet_p99_ms / fleet_failover_s (ISSUE 18, bench.py
+# --serve --fleet): the W-worker fleet's sustained throughput, tail
+# latency, and router failover wall after a worker SIGKILL. They ride
+# their own metric line ("fleet_sustained_rps"), so they only gate
+# against prior fleet rounds — vacuous before the first one.
 LOWER_BETTER = ("t_ours_scores_s", "t_ours_shap_s", "t_ours_fit_s",
                 "serve_p99_ms", "grid_dispatch_count",
                 "shap_dispatch_count", "shap_interact_s",
-                "serve_shed_pct")
+                "serve_shed_pct", "fleet_p99_ms", "fleet_failover_s")
 
 
 def load_history(repo=REPO):

@@ -5,6 +5,7 @@ ISSUE-12 planner drill) with no human in the loop.
     python tools/chaos_drill.py plan     # SIGKILL inside a family program
     python tools/chaos_drill.py serve    # the drain drill
     python tools/chaos_drill.py flight   # SIGKILL vs the flight recorder
+    python tools/chaos_drill.py fleet    # SIGKILL 1 of 3 fleet workers
     python tools/chaos_drill.py lockwatch  # drain + runtime lock witness
     python tools/chaos_drill.py          # default set; exit 0 iff all PASS
     python tools/chaos_drill.py --json   # machine-readable verdicts
@@ -45,7 +46,18 @@ ExecutableStore over the flushed registry dir reproduces the flushed
 ``aot_manifest.json`` signature digests exactly, so a replacement
 process compiles nothing new.
 
-Both drills pin JAX_PLATFORMS=cpu unless the caller overrides it, and
+The fleet drill (fleet, ISSUE 18): a 3-worker serving fleet behind the
+health-gated router, under continuous client load. Mid-load one worker
+takes SIGKILL. PASS requires: ZERO client-visible errors across the
+whole load (every request either completed or was re-dispatched by the
+router's failover path — nothing lost, nothing hard-rejected), the
+router's failover window closes within the deadline, the supervisor
+respawns the killed worker against its restart budget, and a subsequent
+zero-drop rolling restart cycles EVERY worker (drain -> clean exit ->
+free respawn -> fresh heartbeat) with zero errors from the load running
+through it and every worker on a new pid afterwards.
+
+All drills pin JAX_PLATFORMS=cpu unless the caller overrides it, and
 share the persistent XLA compile cache with the test suite (same default
 dir as tests/conftest.py), so repeat runs are cheap. recovery_watch.py
 runs this as its ``chaos`` stage.
@@ -407,18 +419,142 @@ def drill_flight(workdir):
             "checks": checks, "wall_s": round(time.perf_counter() - t0, 2)}
 
 
+def drill_fleet(workdir):
+    """SIGKILL 1 of 3 fleet workers under load (ISSUE 18): the router
+    must fail the orphaned in-flight requests OVER, not up — zero
+    client-visible errors, failover window closed within deadline, the
+    supervisor respawn on budget — then a rolling restart of all three
+    workers drops nothing."""
+    import numpy as np
+
+    from flake16_framework_tpu import config as cfg
+    from flake16_framework_tpu.serve.fleet import Fleet
+    from flake16_framework_tpu.serve.registry import ModelRegistry
+    from flake16_framework_tpu.serve.router import FleetRouter
+    from flake16_framework_tpu.utils import synth
+
+    t0 = time.perf_counter()
+    n_workers = 3
+    failover_deadline_s = 10.0
+
+    feats, labels, _ = synth.make_dataset(n_tests=160, seed=7)
+    feats = np.asarray(feats)
+    reg_dir = os.path.join(workdir, "registry")
+    registry = ModelRegistry(reg_dir)
+    registry.fit_and_register(
+        list(cfg.SHAP_CONFIGS)[0], feats, labels, max_depth=6,
+        tree_overrides={"Extra Trees": 4, "Random Forest": 4},
+        persist=True)
+    model_id = registry.ids()[0]
+
+    checks = {}
+    log(f"fleet: spawning {n_workers} workers over {reg_dir}")
+    with Fleet(reg_dir, n_workers, workdir=workdir,
+               buckets=(4, 16)) as fleet:
+        checks["fleet_ready"] = all(h.alive() for h in fleet.workers)
+        with FleetRouter(fleet) as router:
+            # Continuous client load for the whole drill: each loop is
+            # one scoring request; an exception is a LOST request — the
+            # zero-drop criterion the router must never show a client.
+            stop = threading.Event()
+            counts = {"ok": 0}
+            errors = []
+
+            def client(seed):
+                i = seed
+                while not stop.is_set():
+                    i = (i + 3) % (len(feats) - 4)
+                    try:
+                        router.score(model_id, feats[i:i + 4], timeout=60)
+                        counts["ok"] += 1
+                    except Exception as e:  # noqa: BLE001 — verdict data
+                        errors.append(repr(e))
+
+            loaders = [threading.Thread(target=client, args=(s,),
+                                        daemon=True) for s in range(4)]
+            for th in loaders:
+                th.start()
+            time.sleep(1.0)
+
+            victim = fleet.workers[0]
+            old_pid = victim.pid
+            log(f"fleet: SIGKILL worker 0 (pid {old_pid}) under load")
+            os.kill(old_pid, signal.SIGKILL)
+
+            # Failover window: the router detects the dead link, orphans
+            # its in-flight requests into the repair queue, and closes
+            # the window when the last orphan completes elsewhere.
+            deadline = time.monotonic() + failover_deadline_s
+            while router.last_failover_s is None and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            failover_s = router.last_failover_s
+            checks["failover_closed"] = failover_s is not None
+            checks["failover_in_deadline"] = (
+                failover_s is not None
+                and failover_s <= failover_deadline_s)
+
+            # Supervisor respawn on budget: new pid, alive, one restart
+            # charged, not marked failed.
+            deadline = time.monotonic() + 120
+            while (victim.pid == old_pid or not victim.alive()) and \
+                    time.monotonic() < deadline:
+                time.sleep(0.2)
+            fleet.wait_ready([0], timeout_s=120)
+            checks["respawned"] = victim.pid != old_pid and victim.alive()
+            checks["restart_budget_charged"] = (
+                victim.restarts == 1 and not victim.failed)
+            time.sleep(1.0)  # load through the restored 3-worker fleet
+
+            # Zero-drop rolling restart: every worker drained one at a
+            # time, clean exit, free respawn, fresh heartbeat — with the
+            # client load still running through the router.
+            log("fleet: rolling restart under load")
+            pids_before = fleet.pids()
+            errs_before = len(errors)
+            rolling = router.rolling_restart(drain_deadline_s=15,
+                                             ready_timeout_s=180)
+            checks["rolling_all_workers"] = (
+                len(rolling["steps"]) == n_workers)
+            checks["rolling_new_pids"] = (
+                len(set(fleet.pids()) & set(pids_before)) == 0)
+            checks["rolling_zero_errors"] = len(errors) == errs_before
+
+            time.sleep(1.0)
+            stop.set()
+            for th in loaders:
+                th.join(timeout=60)
+            stats = router.stats()
+
+    checks["some_completed"] = counts["ok"] > 50
+    checks["zero_lost"] = not errors
+    verdict = {"drill": "fleet", "pass": all(checks.values()),
+               "checks": checks,
+               "completed": counts["ok"],
+               "failover_s": failover_s,
+               "router": stats.get("router"),
+               "rolling_steps": rolling["steps"],
+               "wall_s": round(time.perf_counter() - t0, 2)}
+    if errors:
+        verdict["errors"] = errors[:10]
+    log(f"fleet: {counts['ok']} requests ok, {len(errors)} lost, "
+        f"failover_s={failover_s}, "
+        f"router={stats.get('router')}")
+    return verdict
+
+
 def main(argv=None):
     args = sys.argv[1:] if argv is None else list(argv)
     as_json = "--json" in args
     keep = "--keep" in args
     names = [a for a in args if not a.startswith("--")] or \
-        ["sweep", "plan", "serve", "flight"]
+        ["sweep", "plan", "serve", "flight", "fleet"]
     # lockwatch is invocable by name but NOT in the default set: it
     # re-runs the serve child with tracing on — a diagnosis/CI drill,
     # not part of the everyday all-drills sweep.
     drills = {"sweep": drill_sweep, "plan": drill_plan,
               "serve": drill_serve, "flight": drill_flight,
-              "lockwatch": drill_lockwatch}
+              "fleet": drill_fleet, "lockwatch": drill_lockwatch}
     unknown = [n for n in names if n not in drills]
     if unknown:
         raise SystemExit(f"chaos_drill: unknown drill(s) {unknown}; "

@@ -18,8 +18,9 @@ Chain on recovery (each stage bounded, logged to _scratch/watcher_r03.log):
       fresh TPU bench records into _scratch/perfdb.jsonl and run the
       trajectory regression sentinel — evidence, never chain-aborting —
       then the CPU-pinned chaos_drill kill/drain acceptance ->
-      _scratch/chaos_drill.json; chaos FAIL is logged, never aborts the
-      device chain)
+      _scratch/chaos_drill.json and the fleet failover/rolling-restart
+      drill -> _scratch/fleet_drill.json; a chaos/fleet FAIL is logged,
+      never aborts the device chain)
   4. parity.py --full          — PARITY.json at repo root (±0.01 criterion)
   5. hw_probe tune_hist+shap   — knob sweeps (results-neutral: per-node
                                  RNG keys derive from node ids; the SHAP
@@ -398,6 +399,26 @@ def chain():
             pass
     if not ok_lw:
         log("lockwatch drill FAILED — continuing device chain (see log)")
+    # Fault-tolerant fleet drill (ISSUE 18): SIGKILL 1 of 3 serving
+    # workers under client load (zero lost requests, failover within
+    # deadline) plus a zero-drop rolling restart of the whole fleet.
+    # Same contract as chaos/lockwatch: host-side robustness evidence
+    # banked for the next session, never a device-chain gate; the
+    # drill pins its workers to JAX_PLATFORMS=cpu itself, so the W
+    # child processes never contend for the device.
+    ok_fl, out_fl, _ = run_stage(
+        "fleet", [py, os.path.join(REPO, "tools", "chaos_drill.py"),
+                  "fleet", "--json"], 1800)
+    if out_fl and "{" in out_fl:
+        try:
+            rec = json.loads(out_fl[out_fl.index("{"):])
+            with open(os.path.join(REPO, "_scratch",
+                                   "fleet_drill.json"), "w") as fd:
+                json.dump(rec, fd, indent=1)
+        except (ValueError, OSError):
+            pass
+    if not ok_fl:
+        log("fleet drill FAILED — continuing device chain (see log)")
     # parity --full judges the hist (production) tier since ISSUE 9 —
     # the exact fallback tier no longer gates the headline record, so
     # parity runs BEFORE the exact-seed bank. The exact-tier sub-record
