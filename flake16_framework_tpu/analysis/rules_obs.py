@@ -49,6 +49,11 @@ RULES = {r.id: r for r in (
              "hardcoded perfdb schema-version literal outside"
              " obs/schema.py — rows must stamp schema.PERFDB_SCHEMA, or"
              " a version drift splits the database"),
+    RuleInfo("O107", ERROR,
+             "fleet wire-frame dict with a field outside the"
+             " flake16-fleet-wire-v1 census (serve/wire.py WIRE_FIELDS),"
+             " or a census field no wire-speaking module spells —"
+             " two-way wire-protocol drift"),
 )}
 
 # Kinds whose emitters live OUTSIDE the package lint scope (the default
@@ -63,6 +68,12 @@ _SPAN_NAME_RE = re.compile(r"^[a-z0-9_.]+$")
 # literal; only obs/schema.py may spell one (the O106 census — the same
 # single-source-of-truth discipline O104 enforces for event kinds).
 _PERFDB_LITERAL_RE = re.compile(r"^flake16-perfdb-")
+
+# Modules that SPEAK the fleet wire protocol (build or parse frames):
+# O107's reverse direction scans these — and only these — for census
+# field literals, so serve/wire.py's own census definition cannot
+# vacuously satisfy itself.
+_WIRE_SPEAKERS = ("serve/router.py", "serve/fleet.py")
 
 
 def check_module(mod):
@@ -180,7 +191,118 @@ def check_project(mods):
                 "O104", RULES["O104"].severity, node,
                 f"event kind {kind!r} is declared in schema.EVENT_FIELDS "
                 "but no linted module emits it"))
+    findings += _check_wire_census(mods)
     return findings
+
+
+def _load_wire_fields():
+    """serve/wire.py's WIRE_FIELDS census, loaded WITHOUT executing the
+    serve package __init__ (which pulls the whole serving stack — the
+    lint path must stay device- and sklearn-free). Returns None when the
+    module cannot load (the rule then stays silent rather than crashing
+    the lint)."""
+    import sys
+
+    mod = sys.modules.get("flake16_framework_tpu.serve.wire")
+    if mod is None:
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "serve", "wire.py")
+        try:
+            spec = importlib.util.spec_from_file_location(
+                "_f16_wire_census", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception:
+            return None
+    return getattr(mod, "WIRE_FIELDS", None)
+
+
+def _wire_frame_kind(node):
+    """Which flake16-fleet-wire-v1 frame a dict literal spells, by its
+    discriminating keys — request (``id`` + ``op``), response (``id`` +
+    ``ok``), push (sole key ``hb``) — or None for an ordinary dict."""
+    keys = {k.value for k in node.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+    if {"id", "op"} <= keys:
+        return "request"
+    if {"id", "ok"} <= keys:
+        return "response"
+    if keys == {"hb"}:
+        return "push"
+    return None
+
+
+def _check_wire_census(mods):
+    """O107 — the wire-field census sweep, O104's discipline applied to
+    the fleet wire protocol (ISSUE 19 satellite: the trace-context
+    fields ride score frames, so emitters/parsers and the census in
+    serve/wire.py must not drift).
+
+    Forward: any dict literal recognizable as a wire frame (see
+    ``_wire_frame_kind``) whose string keys include a field absent from
+    that frame's census entry — a frame the other end of the socket will
+    silently drop fields from. Reverse: a census field that no
+    wire-speaking module (_WIRE_SPEAKERS) spells as a string literal —
+    dead protocol the census keeps promising; anchored on the census in
+    serve/wire.py and only checked when every speaker is in the linted
+    set (linting a lone file must not indict the protocol)."""
+    wire_fields = _load_wire_fields()
+    if not wire_fields:
+        return []
+    findings = []
+    spoken = set()
+    speakers = set()
+    wire_mod = None
+    for mod in mods:
+        path = mod.path.replace(os.sep, "/")
+        if path.endswith("serve/wire.py"):
+            wire_mod = mod
+        is_speaker = path.endswith(_WIRE_SPEAKERS)
+        if is_speaker:
+            speakers.add(os.path.basename(path))
+        for node in ast.walk(mod.tree):
+            if is_speaker and isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str):
+                spoken.add(node.value)
+            if not isinstance(node, ast.Dict):
+                continue
+            frame = _wire_frame_kind(node)
+            if frame is None:
+                continue
+            allowed = wire_fields[frame]
+            for k in node.keys:
+                if isinstance(k, ast.Constant) \
+                        and isinstance(k.value, str) \
+                        and k.value not in allowed:
+                    findings.append(mod.finding(
+                        "O107", RULES["O107"].severity, k,
+                        f"{frame} frame field {k.value!r} is not in the "
+                        "flake16-fleet-wire-v1 census (serve/wire.py "
+                        f"WIRE_FIELDS[{frame!r}]: {sorted(allowed)})"))
+
+    if wire_mod is not None and len(speakers) == len(_WIRE_SPEAKERS):
+        every = set().union(*wire_fields.values())
+        for field in sorted(every - spoken):
+            node = _first_constant_node(wire_mod.tree, field)
+            if node is None:
+                continue
+            findings.append(wire_mod.finding(
+                "O107", RULES["O107"].severity, node,
+                f"wire field {field!r} is declared in WIRE_FIELDS but "
+                "no wire-speaking module "
+                f"({', '.join(sorted(speakers))}) spells it"))
+    return findings
+
+
+def _first_constant_node(tree, value):
+    """The first string-constant node equal to ``value`` (the reverse
+    O107 finding's anchor inside serve/wire.py's census)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and node.value == value:
+            return node
+    return None
 
 
 def _event_fields_key_node(tree, kind):

@@ -20,6 +20,7 @@ _lockwatch.maybe_install_from_env()
 
 from flake16_framework_tpu.obs.core import (  # noqa: F401
     Span,
+    adopt_trace,
     append_jsonl,
     configure,
     counter_add,

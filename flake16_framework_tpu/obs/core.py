@@ -386,6 +386,26 @@ def mint_trace(parent=None):
     return ctx
 
 
+def adopt_trace(parent):
+    """Adopt a trace context minted in ANOTHER process (ISSUE 19): a
+    fleet worker receiving ``trace_id``/``parent_id`` wire fields joins
+    the router's trace with a fresh local span id and NO second
+    ``F16_TRACE_SAMPLE`` coin flip — the router already made the
+    sampling decision, and re-flipping here would tear sampled requests
+    apart mid-trace. Returns None when ``parent`` is falsy (the request
+    was never sampled) or telemetry is off in this process."""
+    if _state is None or not parent:
+        return None
+    tid = parent.get("trace_id")
+    if not tid:
+        return None
+    ctx = {"trace_id": tid, "span_id": os.urandom(4).hex()}
+    pid = parent.get("parent_id") or parent.get("span_id")
+    if pid:
+        ctx["parent_id"] = pid
+    return ctx
+
+
 # -- manifest -----------------------------------------------------------
 
 

@@ -310,12 +310,26 @@ def env_path(environ=None, run_dir=None):
     return path
 
 
+def ring_worker_index(name):
+    """The fleet worker index a ring filename encodes (the ``.w<i>``
+    suffix ``env_path`` appends under ``F16_FLEET_WORKER``), or None for
+    a non-worker ring (the router/parent's own ``flight.bin``)."""
+    stem, ext = os.path.splitext(os.path.basename(name))
+    stem, dot, tag = stem.rpartition(".")
+    if dot and tag.startswith("w") and tag[1:].isdigit():
+        return int(tag[1:])
+    return None
+
+
 def replay_dir(dirpath):
     """(records, metas) merged by timestamp over every flight ring in a
     directory — the fleet form of ``replay`` (one ring per worker; the
     merged stream is the fleet's interleaved last seconds). Non-ring
     files are skipped; per-ring metas carry each ring's path + torn
-    flag plus the source count."""
+    flag plus the source count. Every replayed event is annotated with
+    the ring it came out of — ``fleet_worker`` = the ``.w<i>`` index
+    for a worker ring (ISSUE 19 satellite: the merged stream stays
+    attributable per process after the sort interleaves it)."""
     records = []
     metas = []
     for name in sorted(os.listdir(dirpath)):
@@ -326,7 +340,10 @@ def replay_dir(dirpath):
             recs, meta = replay(path)
         except (OSError, ValueError):
             continue
-        meta = dict(meta, path=path)
+        worker = ring_worker_index(name)
+        if worker is not None:
+            recs = [dict(ev, fleet_worker=worker) for ev in recs]
+        meta = dict(meta, path=path, worker=worker)
         metas.append(meta)
         records.extend(recs)
     records.sort(key=lambda ev: ev.get("ts") or 0.0)
@@ -350,7 +367,9 @@ def dump_dir(dirpath, out=None, last=60, flush_manifest=True):
               f"{meta['n']} record(s) merged by timestamp"
               + (" — TORN tail(s)\n" if meta["torn"] else "\n"))
     for ring in meta["rings"]:
-        out.write(f"  ring {ring['path']}: {ring['n']} record(s)"
+        who = (f" (worker {ring['worker']})"
+               if ring.get("worker") is not None else "")
+        out.write(f"  ring {ring['path']}{who}: {ring['n']} record(s)"
                   + (" TORN" if ring["torn"] else "") + "\n")
     gauges = last_gauges(records)
     if gauges:
@@ -360,9 +379,11 @@ def dump_dir(dirpath, out=None, last=60, flush_manifest=True):
         ts = ev.get("ts")
         stamp = time.strftime("%H:%M:%S", time.localtime(ts)) \
             if isinstance(ts, (int, float)) else "?"
+        fw = ev.get("fleet_worker")
+        who = f"w{fw}" if isinstance(fw, int) else "--"
         fields = {k: v for k, v in ev.items()
-                  if k not in ("kind", "ts", "run")}
-        out.write(f"  {stamp} {ev.get('kind', '?'):<10} "
+                  if k not in ("kind", "ts", "run", "fleet_worker")}
+        out.write(f"  {stamp} {who:<3} {ev.get('kind', '?'):<10} "
                   + " ".join(f"{k}={v}" for k, v in fields.items())[:160]
                   + "\n")
     dump_path = os.path.join(dirpath, "flight.merged.dump.json")

@@ -35,6 +35,8 @@ METRIC_CENSUS = frozenset({
     # serve/batcher.py + serve/service.py
     "serve.requests", "serve.queue_depth", "serve.p50_ms", "serve.p99_ms",
     "serve.inflight", "serve.shed",
+    # serve/router.py fleet aggregates (ISSUE 19 maintenance tick)
+    "fleet.rps", "fleet.queue_depth", "fleet.inflight", "fleet.workers_up",
     # obs/core.py memory gauges
     "host_rss_peak_mb", "device_mem_peak_mb",
     # parallel/sweep.py grid totals
@@ -61,16 +63,18 @@ def _escape_label(value):
 class MetricsRegistry:
     """Named pull sources. ``register(name, fn)`` takes a zero-arg
     closure returning a number (one sample), a dict (fan-out to
-    ``name{name="key"}`` labeled samples), or None (source currently
-    absent — e.g. device memory on CPU — and skipped, never 0-faked)."""
+    ``name{name="key"}`` labeled samples — ``label=`` picks the label
+    key, e.g. ``worker`` for the federated fleet sources), or None
+    (source currently absent — e.g. device memory on CPU — and skipped,
+    never 0-faked)."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._sources = {}  # name -> (kind, help text, fn)
+        self._sources = {}  # name -> (kind, help text, fn, label key)
 
-    def register(self, name, fn, kind="gauge", help=""):
+    def register(self, name, fn, kind="gauge", help="", label="name"):
         with self._lock:
-            self._sources[name] = (kind, help, fn)
+            self._sources[name] = (kind, help, fn, str(label))
 
     def unregister(self, name):
         with self._lock:
@@ -87,7 +91,7 @@ class MetricsRegistry:
         with self._lock:
             items = sorted(self._sources.items())
         out = []
-        for name, (kind, help_text, fn) in items:
+        for name, (kind, help_text, fn, label_key) in items:
             try:
                 value = fn()
             except Exception:
@@ -95,7 +99,7 @@ class MetricsRegistry:
             if value is None:
                 continue
             if isinstance(value, dict):
-                samples = [({"name": str(k)}, float(v))
+                samples = [({label_key: str(k)}, float(v))
                            for k, v in sorted(value.items())
                            if isinstance(v, (int, float))]
                 if not samples:
@@ -179,6 +183,167 @@ def register_process_sources(registry):
         "f16_events_total", _counter_totals, kind="counter",
         help="Telemetry counter totals by name (the obs.counter_add "
              "census, labeled).")
+    return registry
+
+
+def register_fleet_sources(registry, router, *, scrape_timeout_s=0.5):
+    """Federated fleet sources (ISSUE 19 tentpole b): ONE endpoint for
+    the whole fleet. Per-worker series are labeled ``worker="<i>"`` and
+    come from the heartbeat snapshot each routing link already carries,
+    backfilled by an on-demand ``stats`` scrape (a side connection, see
+    router.scrape_worker_stats) for an up worker whose heartbeat has
+    gone stale; fleet aggregates come from the router's own accounting
+    (latency ring, rps window, SLO monitor, failover records). The
+    per-worker view is sampled once per scrape pass — a 250 ms TTL
+    cache shared by every source below — so one GET costs at most one
+    heartbeat sweep plus one scrape round per stale worker."""
+    import time as _time
+
+    cache = {"t": -1e9, "view": {}}
+    cache_lock = threading.Lock()
+
+    def _view():
+        with cache_lock:
+            now = _time.monotonic()
+            if now - cache["t"] >= 0.25:
+                view = {}
+                stale = []
+                for link in router.links:
+                    snap = link.snapshot()
+                    hb = dict(snap["hb"])
+                    if snap["up"] and (not hb or snap["hb_age_s"]
+                                       > router.stall_s):
+                        stale.append(link.index)
+                    view[link.index] = {"up": snap["up"],
+                                        "pending": snap["pending"],
+                                        "hb": hb}
+                if stale:
+                    scraped = router.scrape_worker_stats(
+                        indices=stale, timeout_s=scrape_timeout_s)
+                    for idx, stats in scraped.items():
+                        hb = view[idx]["hb"]
+                        for field in ("queue_depth", "requests",
+                                      "p50_ms", "p99_ms"):
+                            if stats.get(field) is not None:
+                                hb[field] = stats[field]
+                        hb["quarantined"] = sorted(
+                            stats.get("quarantined") or ())
+                cache["view"] = view
+                cache["t"] = now
+            return cache["view"]
+
+    def per_worker(field):
+        def sample():
+            out = {}
+            for idx, w in _view().items():
+                v = w["hb"].get(field)
+                if isinstance(v, bool):
+                    out[str(idx)] = int(v)
+                elif isinstance(v, (int, float)):
+                    out[str(idx)] = v
+            return out or None
+        return sample
+
+    registry.register(
+        "f16_fleet_worker_up",
+        lambda: {str(i): int(w["up"]) for i, w in _view().items()},
+        label="worker",
+        help="1 while the router's link to this worker is up.")
+    registry.register(
+        "f16_fleet_worker_pending",
+        lambda: {str(i): w["pending"] for i, w in _view().items()},
+        label="worker",
+        help="Requests pending on this worker's link, router side.")
+    registry.register(
+        "f16_fleet_worker_queue_depth", per_worker("queue_depth"),
+        label="worker",
+        help="Worker-reported request queue depth (heartbeat/scrape).")
+    registry.register(
+        "f16_fleet_worker_inflight", per_worker("inflight"),
+        label="worker",
+        help="Worker-reported microbatches inside a dispatch.")
+    registry.register(
+        "f16_fleet_worker_requests_total", per_worker("requests"),
+        kind="counter", label="worker",
+        help="Requests completed by this worker since its start.")
+    registry.register(
+        "f16_fleet_worker_p50_ms", per_worker("p50_ms"), label="worker",
+        help="Worker-local p50 request latency, ms.")
+    registry.register(
+        "f16_fleet_worker_p99_ms", per_worker("p99_ms"), label="worker",
+        help="Worker-local p99 request latency, ms.")
+    registry.register(
+        "f16_fleet_worker_burn_fast", per_worker("burn_fast"),
+        label="worker",
+        help="Worker-local SLO fast-window burn (absent without a "
+             "worker SLO monitor).")
+    registry.register(
+        "f16_fleet_worker_shedding", per_worker("shedding"),
+        label="worker",
+        help="1 while this worker's own SLO monitor is shedding.")
+
+    registry.register(
+        "f16_fleet_workers_up",
+        lambda: sum(1 for w in _view().values() if w["up"]),
+        help="Worker links currently up.")
+    registry.register(
+        "f16_fleet_rps", router.fleet_rps,
+        help="Fleet-wide completed requests per second (router's "
+             "sliding window).")
+    registry.register(
+        "f16_fleet_queue_depth",
+        lambda: sum(w["hb"].get("queue_depth", 0)
+                    for w in _view().values()),
+        help="Sum of worker-reported queue depths.")
+    registry.register(
+        "f16_fleet_inflight",
+        lambda: sum(w["hb"].get("inflight", 0)
+                    for w in _view().values()),
+        help="Sum of worker-reported inflight microbatches.")
+    registry.register(
+        "f16_fleet_quarantined",
+        lambda: len({q for w in _view().values()
+                     for q in (w["hb"].get("quarantined") or ())}),
+        help="Distinct models quarantined anywhere in the fleet.")
+    registry.register(
+        "f16_fleet_requests_total",
+        lambda: router.latency.snapshot()["count"], kind="counter",
+        help="Requests completed through the router.")
+    registry.register(
+        "f16_fleet_p50_ms",
+        lambda: router.latency.snapshot()["p50_ms"],
+        help="Router-observed p50 request latency, ms.")
+    registry.register(
+        "f16_fleet_p99_ms",
+        lambda: router.latency.snapshot()["p99_ms"],
+        help="Router-observed p99 request latency, ms.")
+    registry.register(
+        "f16_fleet_hedges_total", lambda: router.hedges, kind="counter",
+        help="Hedge duplicates sent.")
+    registry.register(
+        "f16_fleet_hedge_coalesced_total",
+        lambda: router.hedge_coalesced, kind="counter",
+        help="Hedge-loser responses coalesced.")
+    registry.register(
+        "f16_fleet_redispatches_total",
+        lambda: router.redispatches, kind="counter",
+        help="Failover/retriable re-dispatches.")
+    registry.register(
+        "f16_fleet_failovers_total",
+        lambda: len(router.failovers), kind="counter",
+        help="Closed failover windows (link deaths recovered).")
+    if router.slo is not None:
+        registry.register(
+            "f16_fleet_burn_fast", lambda: router.slo.burn_fast,
+            help="Fleet SLO burn over the fast window (1.0 = on "
+                 "budget).")
+        registry.register(
+            "f16_fleet_burn_slow", lambda: router.slo.burn_slow,
+            help="Fleet SLO burn over the slow window.")
+        registry.register(
+            "f16_fleet_slo_breaches_total",
+            lambda: router.slo.breaches, kind="counter",
+            help="Fleet-level burn-rate breaches recorded.")
     return registry
 
 

@@ -307,6 +307,21 @@ def rows_from_bench(doc, src, round_tag=None):
             return None
 
     rows = []
+    if metric.startswith("fleet") or "fleet_rps" in detail:
+        # ISSUE 19 satellite: the fleet bench round ("fleet_sustained_rps",
+        # bench.py --serve --fleet W) lands as ONE shape="fleet" row whose
+        # metrics keep their fleet_* names — the exact series bench_gate
+        # keys on (fleet_rps up-only; fleet_p99_ms / fleet_failover_s
+        # down-only) — so perf diff and the trajectory sentinel cover the
+        # fleet trajectory alongside the serve one.
+        metrics = {k: v for k, v in detail.items()
+                   if isinstance(v, (int, float))
+                   and not isinstance(v, bool)
+                   and (k.startswith("fleet_")
+                        or k in ("single_rps", "single_p99_ms",
+                                 "n_cores"))}
+        rows.append(row("fleet", "fleet", metrics, baseline=baseline))
+        return [r for r in rows if r is not None]
     if metric.startswith("serve") or "serve_rps" in detail:
         metrics = {k.replace("serve_", "").replace("slo_", ""): v
                    for k, v in detail.items()

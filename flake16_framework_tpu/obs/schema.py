@@ -91,8 +91,12 @@ EVENT_FIELDS = {
     # Serving-fleet lifecycle (ISSUE 18; serve/fleet.py supervisor and
     # serve/router.py): ``action`` is restart | budget-exhausted |
     # respawn-drained | failed (supervisor, with ``rc``/``restarts``
-    # context) or link-down | rolling-drain | rolling-done (router);
-    # ``worker`` is the fleet index the transition concerns.
+    # context) or link-down | rolling-drain | rolling-done | hedge |
+    # hedge-coalesced | redispatch (router); ``worker`` is the fleet
+    # index the transition concerns. Router events for a SAMPLED request
+    # (ISSUE 19) carry ``trace_id``, so hedge losers and failover
+    # re-dispatches land on the same trace as the request's spans in the
+    # fleet-merged render.
     "fleet": {"action": str, "worker": int},
     # Supervisor child restart (resilience/supervisor.py): ``attempt`` is
     # the 1-based restart number; extra fields ``rc`` (the death the

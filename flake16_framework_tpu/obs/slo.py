@@ -84,6 +84,12 @@ class SLOMonitor:
         self.recoveries = 0
         self.shed_total = 0
         self.observed_total = 0
+        # Cumulative (never-pruned) budget accounting: unlike the burn
+        # windows these survive the sliding horizon, so two snapshots
+        # bracket an interval's error-budget spend exactly — the fleet
+        # rolling-restart annotation (ISSUE 19) is built on the deltas.
+        self.total_errors = 0
+        self.total_over_latency = 0
         self.time_in_degraded_s = 0.0
         self._degraded_since = None
         self._took_rung = False
@@ -96,6 +102,10 @@ class SLOMonitor:
         with self._lock:
             self._samples.append((now, latency_ms, bool(error)))
             self.observed_total += 1
+            if error:
+                self.total_errors += 1
+            elif latency_ms is not None and latency_ms > self.config.p99_ms:
+                self.total_over_latency += 1
             self._prune(now)
 
     def record_shed(self):
@@ -196,6 +206,16 @@ class SLOMonitor:
 
     # -- reporting -------------------------------------------------------
 
+    def budget_snapshot(self):
+        """Cumulative event/error/over-latency totals. Two snapshots
+        bracket an interval; :func:`budget_spend` turns the deltas into
+        that interval's burn — how rolling restarts are annotated with
+        the error budget they spent (ISSUE 19)."""
+        with self._lock:
+            return {"events": self.observed_total,
+                    "errors": self.total_errors,
+                    "over_latency": self.total_over_latency}
+
     def summary(self, now=None):
         """The bench/report rollup (BENCH_r10 detail fields)."""
         now = time.time() if now is None else now
@@ -216,3 +236,22 @@ class SLOMonitor:
                 "shedding": self.shedding,
                 "objective_p99_ms": self.config.p99_ms,
             }
+
+
+def budget_spend(before, after, config):
+    """The error-budget spend of the interval two
+    :meth:`SLOMonitor.budget_snapshot` calls bracket: the event/error/
+    over-latency deltas plus ``burn`` — the interval's measured burn
+    rate under ``config``'s budgets (same max-of-fractions math as
+    :meth:`SLOMonitor._window_burn`, but over an exact interval instead
+    of a sliding window). Zero events = zero burn: an idle interval
+    spends nothing."""
+    events = int(after["events"]) - int(before["events"])
+    errors = int(after["errors"]) - int(before["errors"])
+    over = int(after["over_latency"]) - int(before["over_latency"])
+    burn = 0.0
+    if events > 0:
+        burn = max((over / events) / config.latency_budget,
+                   (errors / events) / config.error_budget)
+    return {"events": events, "errors": errors, "over_latency": over,
+            "burn": round(burn, 3)}

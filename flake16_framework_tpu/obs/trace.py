@@ -3,6 +3,7 @@ manifest.json) into Chrome-trace/Perfetto JSON.
 
     python -m flake16_framework_tpu trace [RUN_DIR] [--out FILE] \
         [--root DIR]
+    python -m flake16_framework_tpu trace --fleet [ROOT] [--out FILE]
 
 Spans become ``X`` (complete) duration events laid out on one lane per
 emitting thread — span events carry ``tid`` since this PR; older logs
@@ -13,6 +14,17 @@ Counters and gauges become ``C`` counter tracks, and the point-like kinds
 preemptions, journal replays and drains included — reads as a timeline
 in chrome://tracing or https://ui.perfetto.dev instead of a JSONL
 scroll.
+
+``--fleet`` renders a WHOLE fleet's telemetry root (``serve --fleet``
+with ``F16_TELEMETRY`` pointing at one directory: the router's run plus
+every worker's) into a single merged Perfetto view: one process lane
+per OS process — the router at pid 1, worker ``i`` (manifest
+``fleet_worker``) at pid ``i + 2`` — against one shared epoch, with
+Chrome flow arrows (``s``/``t``/``f``, id = trace_id) stitching each
+sampled request's router-side ``fleet.request`` span to the worker-side
+``serve.request`` spans it fanned out to. A hedged or failed-over
+request therefore reads as ONE arrow chain crossing process lanes — the
+cross-process trace-propagation witness (ISSUE 19 tentpole a).
 
 ``summarize_device_trace`` is the trace-summarization half of
 tools/hw_trace.py (top device ops by total duration from a perfetto
@@ -29,7 +41,7 @@ import os
 import sys
 from collections import defaultdict
 
-from flake16_framework_tpu.obs import report, schema
+from flake16_framework_tpu.obs import core, report, schema
 
 # Kinds rendered as point events; everything else schema-known is handled
 # explicitly below.
@@ -44,22 +56,42 @@ def _micros(ts, t0):
     return max(0.0, (ts - t0) * 1e6)
 
 
-def chrome_trace(manifest, events):
-    """A Chrome-trace object ({"traceEvents": [...]}) for one run."""
+# Request-scoped span names whose start points anchor cross-process flow
+# arrows in the fleet-merged render: the router's per-request span plus
+# the worker-side request span that adopts its trace context.
+_FLOW_SPAN_NAMES = ("fleet.request", "serve.request")
+
+
+def _run_t0(manifest, events):
+    """One run's epoch: manifest started_ts, else the earliest event."""
     started = manifest.get("started_ts")
+    if isinstance(started, (int, float)):
+        return started
     ts_all = [e["ts"] for e in events
               if isinstance(e.get("ts"), (int, float))]
-    t0 = started if isinstance(started, (int, float)) else (
-        min(ts_all) if ts_all else 0.0)
+    return min(ts_all) if ts_all else 0.0
 
-    out = []
-    argv = manifest.get("argv") or []
-    pname = "flake16 " + " ".join(str(a) for a in argv[1:2]) if argv \
-        else "flake16"
-    out.append({"ph": "M", "pid": _PID, "name": "process_name",
+
+def _render_run(manifest, events, *, pid, t0, out, lanes=None,
+                anchors=None, pname=None):
+    """Append one run's Chrome-trace events to ``out`` as process
+    ``pid`` against the (possibly shared) epoch ``t0``.
+
+    ``lanes`` is the tid allocator — pass one dict across runs so two
+    runs merged onto the same pid (a respawned worker re-using its
+    index) cannot collide lanes. When ``anchors`` is a dict, every
+    _FLOW_SPAN_NAMES span carrying a trace context records its start
+    point into it (``trace_id -> [(start_us, pid, tid)]``) — the raw
+    material for the fleet render's cross-process flow arrows."""
+    if pname is None:
+        argv = manifest.get("argv") or []
+        pname = "flake16 " + " ".join(str(a) for a in argv[1:2]) \
+            if argv else "flake16"
+    out.append({"ph": "M", "pid": pid, "name": "process_name",
                 "args": {"name": pname.strip()}})
 
-    tids = {}  # lane key (thread ident or span family) -> small tid
+    # lane key (thread ident or span family) -> small tid, per pid
+    tids = lanes if lanes is not None else {}
 
     def lane(ev):
         # Per-request lanes first: spans carrying a trace context render
@@ -72,10 +104,12 @@ def chrome_trace(manifest, events):
             key = ev.get("tid")
             if key is None:  # pre-tid logs: lane per span-name family
                 key = str(ev.get("name", "?")).split(".")[0]
+        key = (pid, key)
         if key not in tids:
-            tids[key] = len(tids) + 1
-            label = f"thread {key}" if isinstance(key, int) else key
-            out.append({"ph": "M", "pid": _PID, "tid": tids[key],
+            tids[key] = sum(1 for k in tids if k[0] == pid) + 1
+            label = f"thread {key[1]}" \
+                if isinstance(key[1], int) else key[1]
+            out.append({"ph": "M", "pid": pid, "tid": tids[key],
                         "name": "thread_name", "args": {"name": label}})
         return tids[key]
 
@@ -90,17 +124,24 @@ def chrome_trace(manifest, events):
             args = {k: v for k, v in ev.items()
                     if k not in ("kind", "ts", "run", "name", "wall_s",
                                  "tid")}
-            out.append({"ph": "X", "pid": _PID, "tid": lane(ev),
-                        "ts": _micros(ts - wall, t0),
+            tid = lane(ev)
+            start_us = _micros(ts - wall, t0)
+            out.append({"ph": "X", "pid": pid, "tid": tid,
+                        "ts": start_us,
                         "dur": wall * 1e6, "cat": "span",
                         "name": ev.get("name", "?"), "args": args})
+            if (anchors is not None
+                    and ev.get("name") in _FLOW_SPAN_NAMES
+                    and isinstance(ev.get("trace_id"), str)):
+                anchors.setdefault(ev["trace_id"], []).append(
+                    (start_us, pid, tid))
         elif kind == "counter" and isinstance(ev.get("total"),
                                               (int, float)):
-            out.append({"ph": "C", "pid": _PID, "ts": _micros(ts, t0),
+            out.append({"ph": "C", "pid": pid, "ts": _micros(ts, t0),
                         "name": ev.get("name", "?"),
                         "args": {"total": ev["total"]}})
         elif kind == "gauge" and isinstance(ev.get("value"), (int, float)):
-            out.append({"ph": "C", "pid": _PID, "ts": _micros(ts, t0),
+            out.append({"ph": "C", "pid": pid, "ts": _micros(ts, t0),
                         "name": ev.get("name", "?"),
                         "args": {"value": ev["value"]}})
         elif kind in _INSTANT_KINDS:
@@ -108,12 +149,81 @@ def chrome_trace(manifest, events):
                     if k not in ("kind", "ts", "run")}
             name = kind if kind != "cost" else \
                 f"cost {ev.get('span', '?')}"
-            out.append({"ph": "i", "pid": _PID, "tid": 0, "s": "p",
+            out.append({"ph": "i", "pid": pid, "tid": 0, "s": "p",
                         "ts": _micros(ts, t0), "cat": kind, "name": name,
                         "args": args})
 
+
+def chrome_trace(manifest, events):
+    """A Chrome-trace object ({"traceEvents": [...]}) for one run."""
+    out = []
+    _render_run(manifest, events, pid=_PID,
+                t0=_run_t0(manifest, events), out=out)
     return {"traceEvents": out, "displayTimeUnit": "ms",
             "otherData": {"run": manifest.get("run", "?"),
+                          "schema": schema.TELEMETRY_SCHEMA}}
+
+
+def fleet_chrome_trace(runs):
+    """One MERGED Chrome-trace object for a fleet's telemetry runs
+    (``runs`` = [(manifest, events), ...]: the router's run plus every
+    worker's, all sharing one telemetry root).
+
+    Layout: worker runs (manifest ``fleet_worker`` = i, stamped by
+    serve/fleet.worker_main) land on pid ``i + 2``; the first non-worker
+    run is the router at pid 1; any other non-worker run gets the next
+    free pid. All runs render against ONE epoch (the earliest run's t0)
+    so lanes line up. Every trace_id whose request spans appear in more
+    than one process gets a Chrome flow chain (``s`` at the earliest
+    span start, ``t`` steps, ``f``/``bp:e`` at the last) — in Perfetto
+    that is an arrow from the router's ``fleet.request`` span to each
+    worker ``serve.request`` span that carried the request (hedges and
+    failover re-dispatches included, because they share the trace_id)."""
+    t0s = [t for t in (_run_t0(m, e) for m, e in runs) if t > 0.0]
+    t0 = min(t0s) if t0s else 0.0
+
+    worker_pids = [m.get("fleet_worker") + 2 for m, _ in runs
+                   if isinstance(m.get("fleet_worker"), int)]
+    next_free = max([1] + worker_pids) + 1
+    out = []
+    lanes = {}
+    anchors = {}
+    names = {}  # pid -> process label (the drill asserts on these)
+    router_seen = False
+    run_ids = []
+    for manifest, events in sorted(runs, key=lambda r: _run_t0(*r)):
+        fw = manifest.get("fleet_worker")
+        if isinstance(fw, int):
+            pid, pname = fw + 2, f"worker {fw}"
+        elif not router_seen:
+            pid, pname, router_seen = 1, "flake16 router", True
+        else:
+            pid, pname, next_free = next_free, None, next_free + 1
+        _render_run(manifest, events, pid=pid, t0=t0, out=out,
+                    lanes=lanes, anchors=anchors, pname=pname)
+        names.setdefault(pid, pname or "flake16")
+        run_ids.append(manifest.get("run", "?"))
+
+    n_flows = 0
+    for trace_id, points in sorted(anchors.items()):
+        if len({p[1] for p in points}) < 2:
+            continue  # single-process request: nothing to stitch
+        chain = sorted(points)
+        n_flows += 1
+        for i, (ts_us, pid, tid) in enumerate(chain):
+            ph = "s" if i == 0 else \
+                ("f" if i == len(chain) - 1 else "t")
+            ev = {"ph": ph, "pid": pid, "tid": tid, "ts": ts_us,
+                  "cat": "fleet", "name": "request", "id": trace_id}
+            if ph == "f":
+                ev["bp"] = "e"  # bind to the enclosing slice
+            out.append(ev)
+
+    return {"traceEvents": out, "displayTimeUnit": "ms",
+            "otherData": {"fleet": True, "runs": run_ids,
+                          "processes": {str(p): n
+                                        for p, n in sorted(names.items())},
+                          "stitched_traces": n_flows,
                           "schema": schema.TELEMETRY_SCHEMA}}
 
 
@@ -130,12 +240,45 @@ def write_trace(run_dir, out_path=None):
     return out_path, trace
 
 
+def fleet_runs(root):
+    """[(run_dir, manifest, events), ...] for every telemetry run under
+    ``root``, oldest started first — the fleet render's input (worker
+    runs are the ones whose manifest carries ``fleet_worker``)."""
+    run_dirs = [
+        d for d in (os.path.join(root, n) for n in
+                    (os.listdir(root) if os.path.isdir(root) else ()))
+        if os.path.isfile(os.path.join(d, schema.EVENTS_FILE))]
+    loaded = [(d,) + report.load_run(d) for d in sorted(run_dirs)]
+    return sorted(loaded, key=lambda r: _run_t0(r[1], r[2]))
+
+
+def write_fleet_trace(root, out_path=None):
+    """Render every run under the telemetry root ``root`` into ONE
+    merged fleet Chrome-trace at ``out_path`` (default
+    ``<root>/fleet_trace.json``); returns (path, trace object)."""
+    root = root or core.default_root()
+    runs = fleet_runs(root)
+    if not runs:
+        raise SystemExit(
+            f"no telemetry runs under {root!r} — run serve --fleet with "
+            "F16_TELEMETRY pointing at a directory first (see PROFILE.md "
+            "'Fleet observability')")
+    trace = fleet_chrome_trace([(m, e) for _, m, e in runs])
+    out_path = out_path or os.path.join(root, "fleet_trace.json")
+    from flake16_framework_tpu.utils.atomic import atomic_write
+
+    with atomic_write(out_path, "w") as fd:
+        json.dump(trace, fd)
+    return out_path, trace
+
+
 def trace_main(args, out=None):
     """CLI entry for the ``trace`` verb (``__main__.py``)."""
     out = out or sys.stdout
     root = None
     path = None
     out_path = None
+    fleet = False
     it = iter(args)
     for a in it:
         if a == "--out":
@@ -146,12 +289,23 @@ def trace_main(args, out=None):
             root = next(it, None)
             if root is None:
                 raise ValueError("--root needs a directory argument")
+        elif a == "--fleet":
+            fleet = True
         elif a.startswith("--"):
             raise ValueError(f"Unrecognized trace option {a!r}")
         elif path is None:
             path = a
         else:
             raise ValueError(f"Unrecognized trace argument {a!r}")
+    if fleet:
+        out_path, trace = write_fleet_trace(path or root, out_path)
+        other = trace["otherData"]
+        out.write(f"[{path or root or core.default_root()}]\nwrote "
+                  f"{out_path} ({len(trace['traceEvents'])} trace events, "
+                  f"{len(other['runs'])} runs, "
+                  f"{other['stitched_traces']} stitched requests) — load "
+                  "in chrome://tracing or https://ui.perfetto.dev\n")
+        return out_path
     run_dir = report.find_run_dir(path, root)
     out_path, trace = write_trace(run_dir, out_path)
     n = len(trace["traceEvents"])

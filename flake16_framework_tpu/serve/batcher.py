@@ -209,18 +209,22 @@ class Microbatcher:
                 # at dispatch start; the full request leg ends now —
                 # start = ts - wall in both, so the lane reads
                 # submit→dispatch→response without clock gymnastics.
+                # An adopted cross-process context carries parent_id
+                # (the router's span) — the fleet-merged render stitches
+                # this process's lane to the router's on it.
+                tctx = {"trace_id": r.trace["trace_id"],
+                        "span_id": r.trace["span_id"]}
+                if r.trace.get("parent_id"):
+                    tctx["parent_id"] = r.trace["parent_id"]
                 obs.event("span", name="serve.request.queue",
                           wall_s=round(t_start - r.t_submit, 6),
                           cold=False, ts=round(wall_start, 4),
-                          trace_id=r.trace["trace_id"],
-                          span_id=r.trace["span_id"],
-                          model_id=r.model_id, req_kind=r.kind)
+                          model_id=r.model_id, req_kind=r.kind, **tctx)
                 obs.event("span", name="serve.request",
                           wall_s=round(t_done - r.t_submit, 6),
-                          cold=False, trace_id=r.trace["trace_id"],
-                          span_id=r.trace["span_id"],
+                          cold=False,
                           model_id=r.model_id, req_kind=r.kind, rows=r.n,
-                          coalesced=len(batch))
+                          coalesced=len(batch), **tctx)
         obs.counter_add("serve.requests", len(batch))
         obs.gauge("serve.queue_depth", self.requests.depth())
         obs.gauge("serve.inflight", self.inflight)

@@ -41,6 +41,13 @@ is a drop-in ScoringService from the driver's side. With
 restart walk. ``--worker --socket PATH --registry DIR`` is the child
 half the fleet spawns: load the persisted registry (no fitting), warm,
 answer wire-protocol frames until drained.
+
+ISSUE 19 federates the observability plane over the fleet: with
+``--fleet``, ``--metrics-port`` stands up ONE exporter for the whole
+fleet (per-worker series labeled ``worker="<i>"``, fleet aggregates
+from the router), ``--slo`` arms the fleet-level burn monitor (accounts
+and deprioritizes hot workers, never sheds), and the ``--json`` result
+carries ``fleet.slo`` + ``fleet.rps``.
 """
 
 import json
@@ -208,7 +215,15 @@ def _parse(args):
 def _fleet_main(opts, feats, registry):
     """The ``--fleet W`` body: spawn the worker fleet over the persisted
     registry, route the sustained load through the hedging router, then
-    (optionally) walk a zero-drop rolling restart."""
+    (optionally) walk a zero-drop rolling restart.
+
+    The observability plane (ISSUE 19) hangs off the router here:
+    ``--slo`` declares the FLEET objectives (the router's monitor
+    accounts and deprioritizes, it never sheds — workers keep their own
+    shedding monitors), and ``--metrics-port`` stands up the single
+    FEDERATED exporter — per-worker series labeled ``worker="<i>"``
+    plus fleet aggregates, one endpoint for the whole fleet (workers
+    never open their own)."""
     import os
     import tempfile
 
@@ -218,25 +233,48 @@ def _fleet_main(opts, feats, registry):
     workdir = opts["workdir"] or tempfile.mkdtemp(prefix="f16-fleet-")
     os.makedirs(workdir, exist_ok=True)
     slo_p99 = opts["slo_p99_ms"] if opts["slo"] else None
+    fleet_slo = None  # default: router still accounts with defaults
+    if opts["slo"]:
+        from flake16_framework_tpu.obs.slo import SLOConfig
+
+        fleet_slo = SLOConfig(p99_ms=opts["slo_p99_ms"])
     with Fleet(registry.root, opts["fleet"], workdir=workdir,
                buckets=opts["buckets"], slo_p99_ms=slo_p99) as fleet:
-        with FleetRouter(fleet) as router:
-            result = sustained_load(
-                router, feats, registry.ids(),
-                n_requests=opts["requests"], rows=opts["rows"],
-                kinds=opts["kinds"], clients=opts["clients"])
-            if opts["rolling_restart"]:
-                result["rolling_restart"] = router.rolling_restart(
-                    drain_deadline_s=opts["drain_deadline"])
-            stats = router.stats()
-            result["fleet"] = {
-                "workers": opts["fleet"],
-                "pids": fleet.pids(),
-                "router": stats["router"],
-                "failover_s": router.last_failover_s,
-                "per_worker": [w["hb"].get("requests")
-                               for w in stats["workers"]],
-            }
+        with FleetRouter(fleet, slo=fleet_slo) as router:
+            metrics_srv = None
+            if opts["metrics_port"] is not None:
+                from flake16_framework_tpu.obs import metrics as _metrics
+
+                reg = _metrics.MetricsRegistry()
+                _metrics.register_process_sources(reg)
+                _metrics.register_fleet_sources(reg, router)
+                metrics_srv = _metrics.MetricsServer(
+                    reg, port=opts["metrics_port"]).start()
+                print(f"METRICS_PORT {metrics_srv.port}", flush=True)
+            try:
+                result = sustained_load(
+                    router, feats, registry.ids(),
+                    n_requests=opts["requests"], rows=opts["rows"],
+                    kinds=opts["kinds"], clients=opts["clients"])
+                if opts["rolling_restart"]:
+                    result["rolling_restart"] = router.rolling_restart(
+                        drain_deadline_s=opts["drain_deadline"])
+                stats = router.stats()
+                result["fleet"] = {
+                    "workers": opts["fleet"],
+                    "pids": fleet.pids(),
+                    "router": stats["router"],
+                    "rps": stats["rps"],
+                    "slo": stats["slo"],
+                    "failover_s": router.last_failover_s,
+                    "per_worker": [w["hb"].get("requests")
+                                   for w in stats["workers"]],
+                }
+                if metrics_srv is not None:
+                    result["fleet"]["metrics_port"] = metrics_srv.port
+            finally:
+                if metrics_srv is not None:
+                    metrics_srv.stop()
     result["models"] = registry.ids()
     print(json.dumps(result) if opts["json"]
           else json.dumps(result, indent=1))

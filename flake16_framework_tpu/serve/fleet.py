@@ -238,10 +238,18 @@ class WorkerServer:
                 if op == "score":
                     if self._inject_check():
                         continue  # stalled: accepted, never answered
+                    # Cross-process trace adoption (ISSUE 19): a frame
+                    # carrying trace_id was sampled by the ROUTER — join
+                    # its trace rather than re-flipping the local coin.
+                    parent = None
+                    if "trace_id" in msg:
+                        parent = {"trace_id": msg["trace_id"],
+                                  "parent_id": msg.get("parent_id")}
                     try:
                         fut = self.service.submit(
                             msg["model"], msg["x"],
-                            kind=msg.get("kind", "predict"))
+                            kind=msg.get("kind", "predict"),
+                            trace_parent=parent)
                     except Exception as e:
                         self._send_error(conn, send_lock, rid, e)
                         continue

@@ -44,6 +44,20 @@ move on. Admission at the router never closes; queued-but-unstarted
 requests the drain rejects come back retriable and re-route. The drill
 asserts 0 client-visible errors across the whole walk.
 
+**Observability plane (ISSUE 19).** Every submit mints an
+``obs.mint_trace`` context; sampled requests carry it across the wire
+(serve/wire.py trace fields) so worker spans adopt the router's trace
+id, and the router's own ``fleet.request`` span plus its hedge /
+hedge-coalesced / redispatch ``fleet`` events land on the SAME trace —
+one Perfetto render (``trace --fleet``) shows a hedged, failed-over
+request as one correlated story across every process it touched. A
+fleet-level SLO monitor (obs/slo.SLOMonitor, ``degrade=False`` — it
+accounts, it never sheds) folds the router-observed latency/error
+stream into burn rates; a worker whose heartbeat carries a hot local
+burn is DEPRIORITIZED in selection (a load penalty, not a gate — it
+still serves when it is the only one standing), and rolling restarts
+are annotated with the error-budget spend their window cost.
+
 Lock discipline (f16race C-pack): the router's locks form a flat
 order — a link's ``_lock`` guards that link's pending map + heartbeat
 state, the router's ``_lock`` guards counters/failover records, a
@@ -52,6 +66,7 @@ them except link→request (completion under the link's pop) and
 router→nothing; lockwatch sees a cycle-free order.
 """
 
+import collections
 import os
 import random
 import threading
@@ -59,6 +74,8 @@ import time
 
 import queue as _stdqueue
 
+from flake16_framework_tpu import obs
+from flake16_framework_tpu.obs import slo as _slo
 from flake16_framework_tpu.serve import wire
 from flake16_framework_tpu.serve.queue import (
     RequestRejected, RetriableRejection, ServeError,
@@ -73,6 +90,17 @@ DEFAULT_HEDGE_MS = 400.0
 # un-routable (stalled or dead) even while its socket stays open.
 STALL_ENV = "F16_FLEET_STALL_S"
 DEFAULT_STALL_S = 2.0
+
+# SLO deprioritization (ISSUE 19): a worker heartbeating a fast-window
+# burn over 1.0 (spending faster than budget) has each excess burn unit
+# priced as this many queued requests in the least-loaded pick. High
+# enough to steer load away from a hot replica before it breaches and
+# sheds; bounded (see WorkerLink.load) so a burning worker is never
+# priced out entirely — deprioritized, not gated.
+BURN_PENALTY_LOAD = 8.0
+
+# Sliding window for the fleet requests-per-second aggregate, seconds.
+RPS_WINDOW_S = 10.0
 
 
 def hedge_ms_from_env(environ=None):
@@ -103,14 +131,15 @@ class FleetRequest:
     """One routed request's future. ``_complete``/``_fail`` return False
     when the request already finished — the hedge-coalescing check."""
 
-    __slots__ = ("rid", "model_id", "x", "kind", "t_submit", "attempts",
-                 "failover", "_evt", "_out", "_exc", "_lock")
+    __slots__ = ("rid", "model_id", "x", "kind", "trace", "t_submit",
+                 "attempts", "failover", "_evt", "_out", "_exc", "_lock")
 
-    def __init__(self, rid, model_id, x, kind):
+    def __init__(self, rid, model_id, x, kind, trace=None):
         self.rid = rid
         self.model_id = model_id
         self.x = x
         self.kind = kind
+        self.trace = trace   # obs.mint_trace ctx (None = unsampled)
         self.t_submit = time.perf_counter()
         self.attempts = []   # worker indices this request was sent to
         self.failover = False  # orphaned by a link death (accounting)
@@ -287,16 +316,24 @@ class WorkerLink:
 
     def load(self):
         """The selection metric: router-side pending + worker-reported
-        queue depth and inflight."""
+        queue depth and inflight, plus the SLO deprioritization penalty
+        (ISSUE 19) — excess fast-window burn the worker heartbeats is
+        priced as queued work, capped at 4 burn units so a hot replica
+        is steered around, never starved."""
         with self._lock:
-            return (len(self.pending) + self.hb.get("queue_depth", 0)
+            base = (len(self.pending) + self.hb.get("queue_depth", 0)
                     + self.hb.get("inflight", 0))
+            burn = self.hb.get("burn_fast", 0.0) or 0.0
+        if burn > 1.0:
+            base += min(burn - 1.0, 4.0) * BURN_PENALTY_LOAD
+        return base
 
     def snapshot(self):
         with self._lock:
             return {"index": self.index, "up": self.up,
                     "draining": self.draining,
                     "pending": len(self.pending),
+                    "hb_age_s": round(time.monotonic() - self.last_hb, 3),
                     "hb": dict(self.hb)}
 
 
@@ -307,7 +344,7 @@ class FleetRouter:
 
     def __init__(self, fleet=None, *, socket_paths=None, hedge_ms=None,
                  stall_s=None, backoff=None, max_attempts=None,
-                 environ=None, seed=0):
+                 environ=None, seed=0, slo=None):
         from flake16_framework_tpu.resilience import guard as _guard
 
         env = os.environ if environ is None else environ
@@ -337,6 +374,21 @@ class FleetRouter:
         self._repair_q = _stdqueue.Queue()
         self._stop = threading.Event()
         self._threads = []
+        # Fleet-level SLO accounting (ISSUE 19): the merged latency/
+        # error stream every worker's responses flow through, folded by
+        # one monitor that NEVER sheds or degrades (accounting + the
+        # load()-side deprioritization signal; admission stays open —
+        # per-worker monitors own shedding). ``slo=False`` disables;
+        # an SLOConfig customizes the objectives.
+        self.slo = None
+        if slo is not False:
+            cfg = slo if isinstance(slo, _slo.SLOConfig) \
+                else _slo.SLOConfig(degrade=False)
+            cfg.degrade = False  # the fleet monitor must never actuate
+            self.slo = _slo.SLOMonitor(cfg)
+        # (monotonic ts, completed) samples the maintenance loop appends
+        # ~1/s — the fleet_rps aggregate's sliding window.
+        self._rps_window = collections.deque()
 
     # -- lifecycle -------------------------------------------------------
 
@@ -374,6 +426,7 @@ class FleetRouter:
     # -- maintenance (reconnect + failover recovery bookkeeping) ---------
 
     def _maintenance_loop(self):
+        next_obs = 0.0
         while not self._stop.wait(0.1):
             for link in self.links:
                 with link._lock:
@@ -383,6 +436,44 @@ class FleetRouter:
                         link.connect(timeout=0.5)
                     except OSError:
                         continue
+            now = time.monotonic()
+            if now >= next_obs:
+                next_obs = now + 1.0
+                self._observe_fleet(now)
+
+    def _observe_fleet(self, now=None):
+        """The ~1 Hz fleet accounting tick: advance the rps window,
+        evaluate the fleet SLO monitor (its breach/recovered ``slo``
+        events are the fleet-level burn witness), and stamp the fleet
+        aggregate gauges — all no-ops beyond an is-None check when
+        telemetry is off."""
+        now = time.monotonic() if now is None else now
+        snaps = [link.snapshot() for link in self.links]
+        with self._lock:
+            self._rps_window.append((now, self.completed))
+            while len(self._rps_window) > 2 \
+                    and now - self._rps_window[0][0] > RPS_WINDOW_S:
+                self._rps_window.popleft()
+        if self.slo is not None:
+            self.slo.evaluate()
+        obs.gauge("fleet.rps", self.fleet_rps())
+        obs.gauge("fleet.queue_depth",
+                  sum(s["hb"].get("queue_depth", 0) for s in snaps))
+        obs.gauge("fleet.inflight",
+                  sum(s["hb"].get("inflight", 0) for s in snaps))
+        obs.gauge("fleet.workers_up", sum(1 for s in snaps if s["up"]))
+
+    def fleet_rps(self):
+        """Completed requests per second over the sliding window the
+        maintenance loop samples (0.0 until two samples exist)."""
+        with self._lock:
+            if len(self._rps_window) < 2:
+                return 0.0
+            t0, c0 = self._rps_window[0]
+            t1, c1 = self._rps_window[-1]
+        if t1 <= t0:
+            return 0.0
+        return round((c1 - c0) / (t1 - t0), 3)
 
     def _repair_loop(self):
         """Re-dispatch orphaned/rejected requests off the reader threads
@@ -404,9 +495,16 @@ class FleetRouter:
             if delay:
                 time.sleep(min(delay, 2.0))
             try:
-                self._dispatch(req, exclude=exclude)
+                link = self._dispatch(req, exclude=exclude)
                 with self._lock:
                     self.redispatches += 1
+                if req.trace:
+                    # Failover/retriable re-dispatch on the request's
+                    # own trace: the merged render shows the hop.
+                    obs.event("fleet", action="redispatch",
+                              worker=link.index, rid=req.rid,
+                              failover=req.failover,
+                              trace_id=req.trace["trace_id"])
             except NoRoutableWorker:
                 if attempt + 1 >= self.max_attempts:
                     req._fail(NoRoutableWorker(
@@ -441,6 +539,13 @@ class FleetRouter:
         tried = set(exclude)
         msg = {"id": req.rid, "op": "score", "model": req.model_id,
                "kind": req.kind, "x": req.x}
+        if req.trace:
+            # Cross-process trace context (ISSUE 19) — sampled requests
+            # only, so an unsampled frame stays byte-identical to the
+            # pre-trace wire. The router's span id is the worker's
+            # parent: its serve.request span nests under fleet.request.
+            msg["trace_id"] = req.trace["trace_id"]
+            msg["parent_id"] = req.trace["span_id"]
         while True:
             link = self._pick(tried)
             try:
@@ -457,7 +562,8 @@ class FleetRouter:
     # -- reader callbacks ------------------------------------------------
 
     def _on_response(self, link, req, msg):
-        if msg.get("ok"):
+        ok = bool(msg.get("ok"))
+        if ok:
             first = req._complete(msg.get("out"))
         else:
             exc = _rebuild_error(msg)
@@ -470,12 +576,35 @@ class FleetRouter:
         if first:
             latency_ms = (time.perf_counter() - req.t_submit) * 1000.0
             self.latency.record(latency_ms)
+            if self.slo is not None:
+                # The merged fleet stream: every first completion from
+                # ANY worker, errors included — the burn the rolling
+                # restart annotation and `serve --json` report.
+                self.slo.observe(latency_ms=latency_ms if ok else None,
+                                 error=not ok)
             with self._lock:
                 self.completed += 1
+            if req.trace:
+                # The router's half of the cross-process trace: one
+                # fleet.request span per sampled request, on the same
+                # trace id the worker's serve.request span adopted.
+                obs.event("span", name="fleet.request",
+                          wall_s=round(latency_ms / 1000.0, 6),
+                          cold=False, trace_id=req.trace["trace_id"],
+                          span_id=req.trace["span_id"],
+                          model_id=req.model_id, req_kind=req.kind,
+                          worker=link.index, ok=ok,
+                          attempts=len(req.attempts),
+                          failover=req.failover)
             self._note_recovered(req)
         else:
             with self._lock:
                 self.hedge_coalesced += 1
+            if req.trace:
+                # The hedge LOSER, on the same trace as the winner.
+                obs.event("fleet", action="hedge-coalesced",
+                          worker=link.index, rid=req.rid,
+                          trace_id=req.trace["trace_id"])
 
     def _on_unmatched(self, index, msg):
         """A response whose rid has no pending entry on that link: a
@@ -503,10 +632,10 @@ class FleetRouter:
                     }
                 self._open_failover["n_orphans"] += len(live)
                 self._open_failover["outstanding"] += len(live)
-        from flake16_framework_tpu import obs
-
         obs.event("fleet", action="link-down", worker=link.index,
-                  orphans=len(live))
+                  orphans=len(live),
+                  trace_ids=[r.trace["trace_id"]
+                             for r in live if r.trace])
         for req in live:
             # attempt=1 → one backoff step before the re-dispatch; the
             # dead worker is excluded outright.
@@ -545,7 +674,11 @@ class FleetRouter:
         with self._lock:
             self._rid += 1
             rid = self._rid
-        req = FleetRequest(rid, model_id, x, kind)
+        # The fleet's ONE sampling decision (F16_TRACE_SAMPLE) — minted
+        # here, carried on the wire, adopted by every worker the request
+        # touches. None (telemetry off / coin lost) costs nothing
+        # downstream: no wire fields, no events.
+        req = FleetRequest(rid, model_id, x, kind, trace=obs.mint_trace())
         try:
             self._dispatch(req)
         except NoRoutableWorker:
@@ -578,9 +711,15 @@ class FleetRouter:
             if hedge_n + 1 < self.max_attempts:
                 hedge_n += 1
                 try:
-                    self._dispatch(req, exclude=tuple(req.attempts))
+                    link = self._dispatch(req, exclude=tuple(req.attempts))
                     with self._lock:
                         self.hedges += 1
+                    if req.trace:
+                        # The hedge duplicate, on the request's trace.
+                        obs.event("fleet", action="hedge",
+                                  worker=link.index, rid=req.rid,
+                                  hedge_n=hedge_n,
+                                  trace_id=req.trace["trace_id"])
                 except NoRoutableWorker:
                     pass  # keep waiting on the original
 
@@ -606,7 +745,47 @@ class FleetRouter:
             "quarantined": quarantined,
             "workers": workers,
             "router": counters,
+            "rps": self.fleet_rps(),
+            "slo": self.slo.summary() if self.slo is not None else None,
         }
+
+    def scrape_worker_stats(self, indices=None, timeout_s=2.0):
+        """On-demand worker scrape (ISSUE 19): a synchronous ``stats``
+        round-trip per worker over a SIDE connection, so the routing
+        link's pending map and latency accounting never see control
+        traffic. Returns {worker index: stats dict}; a worker that is
+        down or silent within ``timeout_s`` is simply absent — the
+        federated exporter treats that like any other absent source."""
+        out = {}
+        links = (self.links if indices is None
+                 else [self.links[i] for i in indices])
+        for link in links:
+            try:
+                sock = wire.connect_unix(link.socket_path,
+                                         timeout=timeout_s)
+            except OSError:
+                continue
+            try:
+                sock.settimeout(timeout_s)
+                wire.send_msg(sock, {"id": 0, "op": "stats"})
+                deadline = time.monotonic() + timeout_s
+                while time.monotonic() < deadline:
+                    msg = wire.recv_msg(sock)
+                    if msg is None:
+                        break
+                    # Heartbeat pushes arrive on this connection too —
+                    # skip them; only the stats response ends the read.
+                    if isinstance(msg, dict) and "stats" in msg:
+                        out[link.index] = msg["stats"]
+                        break
+            except (wire.WireError, OSError):
+                pass
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        return out
 
     # -- rolling restart -------------------------------------------------
 
@@ -618,11 +797,14 @@ class FleetRouter:
         chaos drill asserts 0 errors rode along client-side."""
         if self.fleet is None:
             raise ValueError("rolling_restart needs a managed fleet")
-        from flake16_framework_tpu import obs
-
+        walk_t0 = time.monotonic()
+        walk_before = (self.slo.budget_snapshot()
+                       if self.slo is not None else None)
         steps = []
         for link in self.links:
             t0 = time.monotonic()
+            step_before = (self.slo.budget_snapshot()
+                           if self.slo is not None else None)
             handle = self.fleet.workers[link.index]
             old_pid = handle.pid
             with link._lock:
@@ -677,10 +859,23 @@ class FleetRouter:
                         f"worker {link.index} respawned but no "
                         f"heartbeat within {ready_timeout_s}s")
                 time.sleep(0.05)
-            steps.append({"worker": link.index, "old_pid": old_pid,
-                          "new_pid": handle.pid,
-                          "wall_s": round(time.monotonic() - t0, 3)})
+            step = {"worker": link.index, "old_pid": old_pid,
+                    "new_pid": handle.pid,
+                    "wall_s": round(time.monotonic() - t0, 3)}
+            if step_before is not None:
+                # What this worker's drain window cost the fleet error
+                # budget (ISSUE 19) — the restart's operability price.
+                step["budget"] = _slo.budget_spend(
+                    step_before, self.slo.budget_snapshot(),
+                    self.slo.config)
+            steps.append(step)
             obs.event("fleet", action="rolling-done", worker=link.index,
                       new_pid=handle.pid,
-                      wall_s=steps[-1]["wall_s"])
-        return {"workers": len(steps), "steps": steps}
+                      wall_s=step["wall_s"],
+                      budget_burn=step.get("budget", {}).get("burn"))
+        result = {"workers": len(steps), "steps": steps,
+                  "wall_s": round(time.monotonic() - walk_t0, 3)}
+        if walk_before is not None:
+            result["budget"] = _slo.budget_spend(
+                walk_before, self.slo.budget_snapshot(), self.slo.config)
+        return result

@@ -312,13 +312,19 @@ class ScoringService:
                 " (split client-side)")
         return model, x
 
-    def submit(self, model_id, x, kind="predict"):
+    def submit(self, model_id, x, kind="predict", trace_parent=None):
         """Admit one request; returns the :class:`ScoreRequest` future.
         A trace context is minted here (F16_TRACE_SAMPLE) and rides the
-        request through the batcher to the response."""
+        request through the batcher to the response. ``trace_parent`` is
+        the cross-process context a fleet worker received on the wire
+        (ISSUE 19): when present the request ADOPTS the router's trace
+        id instead of flipping a second sampling coin, so its spans nest
+        under the router's span in the fleet-merged render."""
         _, x = self._admit(model_id, x, kind)
+        trace = (obs.adopt_trace(trace_parent) if trace_parent
+                 else obs.mint_trace())
         return self.requests.submit(
-            ScoreRequest(model_id, x, kind=kind, trace=obs.mint_trace()))
+            ScoreRequest(model_id, x, kind=kind, trace=trace))
 
     def score(self, model_id, x, kind="predict", timeout=None):
         """Synchronous submit+result."""

@@ -17,10 +17,22 @@ Message grammar (schema tag ``flake16-fleet-wire-v1``; PROFILE.md
 router -> worker requests (``id`` is the router-minted request id —
 the coalescing key for hedged duplicates):
 
-    {"id": N, "op": "score", "model": mid, "kind": k, "x": <array>}
+    {"id": N, "op": "score", "model": mid, "kind": k, "x": <array>,
+     "trace_id": t, "parent_id": s}                # trace ctx, sampled only
     {"id": N, "op": "ping"}
     {"id": N, "op": "stats"}
     {"id": N, "op": "drain", "deadline_s": S}
+
+``trace_id``/``parent_id`` are the cross-process trace context (ISSUE
+19): the router-minted ``obs.mint_trace()`` trace id plus the router's
+request span id. Both appear ONLY when the router sampled the request
+(``F16_TRACE_SAMPLE`` coin) — an unsampled request's frame is
+byte-identical to the pre-trace wire, so the propagation is zero-cost
+when tracing is off. The worker adopts the inbound context via
+``obs.adopt_trace`` so its ``serve.request`` spans nest under the
+router's span on the SAME trace id; hedged duplicates carry the same
+context, which is what lets one fleet-merged Perfetto render stitch a
+request across every process it touched.
 
 worker -> router responses (matched to the pending request by ``id``):
 
@@ -52,6 +64,25 @@ import struct
 import numpy as np
 
 WIRE_SCHEMA = "flake16-fleet-wire-v1"
+
+# Field census for the three frame kinds above — the single source of
+# truth the O107 lint rule holds emitters and parsers to. A frame key
+# that is not in its kind's census is wire drift: either the docstring
+# grammar above and this census grow together (a deliberate protocol
+# rev) or the emitter is wrong. Trace-context fields are first-class
+# members of the request census (ISSUE 19), not an extension.
+TRACE_FIELDS = frozenset({"trace_id", "parent_id"})
+REQUEST_FIELDS = frozenset(
+    {"id", "op", "model", "kind", "x", "deadline_s"}) | TRACE_FIELDS
+RESPONSE_FIELDS = frozenset(
+    {"id", "ok", "out", "error", "retriable", "error_type",
+     "worker", "pid", "stats", "acct"})
+PUSH_FIELDS = frozenset({"hb"})
+WIRE_FIELDS = {
+    "request": REQUEST_FIELDS,
+    "response": RESPONSE_FIELDS,
+    "push": PUSH_FIELDS,
+}
 
 _LEN = struct.Struct(">I")
 # A score frame is <= bucket_max x n_features float32 + envelope; 64 MiB

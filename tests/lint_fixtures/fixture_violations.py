@@ -66,6 +66,11 @@ def perfdb_schema_drift():
     return {"schema": "flake16-perfdb-v0"}           # expect O106
 
 
+def wire_frame_drift():
+    return {"id": 7, "op": "score", "model": "m", "x": [],
+            "sharding": "mesh"}                      # expect O107
+
+
 def unguarded_dispatch(x):
     try:
         return jax.block_until_ready(jnp.sum(x))

@@ -26,14 +26,19 @@ import struct
 import subprocess
 import sys
 import time
+import urllib.request
 
 import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-from flake16_framework_tpu import config as cfg  # noqa: E402
-from flake16_framework_tpu.obs import flight, perfdb  # noqa: E402
+from flake16_framework_tpu import config as cfg, obs  # noqa: E402
+from flake16_framework_tpu.obs import (  # noqa: E402
+    flight, metrics, perfdb, schema,
+)
+from flake16_framework_tpu.obs import trace as obs_trace  # noqa: E402
+from flake16_framework_tpu.obs.slo import SLOConfig  # noqa: E402
 from flake16_framework_tpu.resilience import inject  # noqa: E402
 from flake16_framework_tpu.serve import wire  # noqa: E402
 from flake16_framework_tpu.serve.fleet import Fleet  # noqa: E402
@@ -319,6 +324,173 @@ def test_fleet_worker_stall_gated_and_hedged(fleet_registry,
             time.sleep(1.5)
             stalled = [w for w in router.links if not w.routable(1.0)]
             assert any(w.index == 0 for w in stalled)
+
+
+# -- fleet observability plane (ISSUE 19) -------------------------------
+
+
+def _run_events(run_dir):
+    out = []
+    with open(os.path.join(run_dir, schema.EVENTS_FILE)) as fd:
+        for line in fd:
+            if line.strip():
+                out.append(json.loads(line))
+    return out
+
+
+def test_wire_trace_context_roundtrip():
+    """Trace context rides the score frame as first-class census fields
+    and survives the codec; an unsampled frame simply has no trace keys
+    — byte-identical to the pre-trace wire."""
+    assert wire.TRACE_FIELDS == frozenset({"trace_id", "parent_id"})
+    assert wire.TRACE_FIELDS <= wire.WIRE_FIELDS["request"]
+    msg = {"id": 3, "op": "score", "model": "m",
+           "x": np.ones((2, 4), dtype=np.float32),
+           "trace_id": "a1b2c3d4e5f60718", "parent_id": "0badcafe"}
+    back = wire.unpack_payload(wire.pack(msg)[4:])
+    assert back["trace_id"] == msg["trace_id"]
+    assert back["parent_id"] == msg["parent_id"]
+    plain = {"id": 3, "op": "score", "model": "m", "x": [1.0, 2.0]}
+    assert wire.pack(plain) == wire.pack(dict(plain))
+    assert b"trace_id" not in wire.pack(plain)
+
+
+def test_fleet_trace_propagation_end_to_end(fleet_registry, data,
+                                            tmp_path, monkeypatch):
+    """Tentpole acceptance: sampled requests carry their trace across
+    the wire — each worker ``serve.request`` span adopts the router's
+    context (same trace_id, parent_id = the router span) and the merged
+    fleet render stitches every request across processes."""
+    reg_dir, model_id = fleet_registry
+    feats, _ = data
+    tel_root = str(tmp_path / "telemetry")
+    monkeypatch.setenv("F16_TRACE_SAMPLE", "1")
+    env = dict(os.environ, F16_TELEMETRY=tel_root, F16_TRACE_SAMPLE="1")
+    router_run = obs.configure(root=tel_root, heartbeat_s=0)
+    try:
+        with Fleet(reg_dir, 2, workdir=str(tmp_path / "work"),
+                   buckets=BUCKETS, env=env) as fleet:
+            with FleetRouter(fleet) as router:
+                for i in range(6):
+                    router.score(model_id, feats[i:i + 4], timeout=60)
+    finally:
+        obs.shutdown()
+
+    router_spans = [e for e in _run_events(router_run)
+                    if e.get("kind") == "span"
+                    and e.get("name") == "fleet.request"]
+    assert len(router_spans) == 6
+    router_tids = {e["trace_id"] for e in router_spans}
+    assert len(router_tids) == 6  # one trace per request
+
+    worker_spans = []
+    worker_indices = set()
+    for _, manifest, events in obs_trace.fleet_runs(tel_root):
+        fw = manifest.get("fleet_worker")
+        if not isinstance(fw, int):
+            continue
+        worker_indices.add(fw)
+        worker_spans += [e for e in events if e.get("kind") == "span"
+                         and e.get("name") == "serve.request"]
+    assert len(worker_indices) == 2  # both workers armed telemetry
+    # every worker span adopted the inbound context: router's trace_id,
+    # the router span as parent
+    assert {e.get("trace_id") for e in worker_spans} == router_tids
+    span_by_tid = {e["trace_id"]: e for e in router_spans}
+    for ev in worker_spans:
+        assert ev.get("parent_id") == span_by_tid[ev["trace_id"]].get(
+            "span_id")
+
+    _, trace = obs_trace.write_fleet_trace(
+        tel_root, out_path=str(tmp_path / "merged.json"))
+    other = trace["otherData"]
+    assert other["stitched_traces"] == 6
+    assert other["processes"]["1"] == "flake16 router"
+    workers = [n for n in other["processes"].values()
+               if str(n).startswith("worker ")]
+    assert len(workers) == 2
+
+
+def test_fleet_federated_metrics_endpoint(fleet_pair, data):
+    """Tentpole acceptance: ONE endpoint federates the whole fleet —
+    worker-labeled series for both workers plus fleet aggregates, in
+    valid Prometheus exposition."""
+    fleet, router, model_id = fleet_pair
+    feats, _ = data
+    reg = metrics.MetricsRegistry()
+    metrics.register_fleet_sources(reg, router)
+    for i in range(4):
+        router.score(model_id, feats[i:i + 4], timeout=60)
+    time.sleep(1.2)  # one heartbeat sweep so worker-reported stats land
+    with metrics.MetricsServer(reg, port=0) as server:
+        url = f"http://127.0.0.1:{server.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            body = resp.read().decode()
+    assert metrics.validate_exposition(body) == []
+    assert 'f16_fleet_worker_up{worker="0"} 1' in body
+    assert 'f16_fleet_worker_up{worker="1"} 1' in body
+    names = {line.split()[2] for line in body.splitlines()
+             if line.startswith("# TYPE ")}
+    for expected in ("f16_fleet_worker_up", "f16_fleet_worker_pending",
+                     "f16_fleet_workers_up", "f16_fleet_rps",
+                     "f16_fleet_queue_depth", "f16_fleet_inflight",
+                     "f16_fleet_quarantined", "f16_fleet_requests_total",
+                     "f16_fleet_p99_ms", "f16_fleet_redispatches_total",
+                     "f16_fleet_burn_fast"):
+        assert expected in names, (expected, sorted(names))
+
+
+def test_fleet_router_slo_is_observe_only(tmp_path):
+    """The fleet monitor measures and deprioritizes, never sheds or
+    degrades: ``degrade`` is forced off whatever config arrives, and
+    ``slo=False`` disarms it entirely."""
+    sock = str(tmp_path / "w0.sock")
+    router = FleetRouter(socket_paths=[sock])
+    assert router.slo is not None
+    assert router.slo.config.degrade is False
+    assert FleetRouter(socket_paths=[sock], slo=False).slo is None
+    custom = FleetRouter(socket_paths=[sock],
+                         slo=SLOConfig(p99_ms=75.0, degrade=True))
+    assert custom.slo.config.p99_ms == 75.0
+    assert custom.slo.config.degrade is False
+
+
+def test_fleet_unsampled_is_zero_overhead(fleet_pair, data, monkeypatch):
+    """With telemetry off no trace context is minted, so the dispatch
+    path adds no trace fields to the frame and emits no span events —
+    the observability plane costs nothing unless armed."""
+    fleet, router, model_id = fleet_pair
+    feats, _ = data
+    monkeypatch.delenv("F16_TRACE_SAMPLE", raising=False)
+    assert obs.mint_trace() is None  # telemetry off in this process
+    req = router.submit(model_id, feats[:4])
+    req.result(timeout=60)
+    # req.trace gates EVERY trace cost: the wire fields in _dispatch,
+    # the fleet.request span, the redispatch/hedge event annotations
+    assert req.trace is None
+
+
+def test_perfdb_ingests_fleet_bench_record():
+    """The fleet bench record lands as one shape="fleet" row keeping the
+    fleet_* metric names — so perf diff and the sentinel cover the
+    fleet series with no special-casing."""
+    doc = {"metric": "fleet_sustained_rps", "value": 900.0,
+           "detail": {"backend": "cpu", "fleet_rps": 900.0,
+                      "fleet_p99_ms": 12.5, "fleet_p50_ms": 4.0,
+                      "fleet_failover_s": 1.5, "fleet_workers": 3,
+                      "single_rps": 400.0, "single_p99_ms": 9.0,
+                      "n_cores": 8, "scaling_ok": True,
+                      "router": {"completed": 1000}}}
+    rows = perfdb.rows_from_bench(doc, "bench_fleet.json")
+    fleet_rows = [r for r in rows if r["shape"] == "fleet"]
+    assert len(fleet_rows) == 1
+    row = fleet_rows[0]
+    assert row["kernel"] == "fleet"
+    for name in ("fleet_rps", "fleet_p99_ms", "fleet_failover_s",
+                 "fleet_workers", "single_rps", "n_cores"):
+        assert name in row["metrics"], sorted(row["metrics"])
+    assert "scaling_ok" not in row["metrics"]  # bools are not series
+    assert "router" not in row["metrics"]
 
 
 def test_no_routable_worker_is_retriable(tmp_path):

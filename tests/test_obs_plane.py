@@ -30,7 +30,9 @@ from flake16_framework_tpu.obs import core as obs_core  # noqa: E402
 from flake16_framework_tpu.obs import flight, metrics, schema  # noqa: E402
 from flake16_framework_tpu.obs import report as obs_report  # noqa: E402
 from flake16_framework_tpu.obs import trace as obs_trace  # noqa: E402
-from flake16_framework_tpu.obs.slo import SLOConfig, SLOMonitor  # noqa: E402
+from flake16_framework_tpu.obs.slo import (  # noqa: E402
+    SLOConfig, SLOMonitor, budget_spend,
+)
 from flake16_framework_tpu.resilience import inject, ladder  # noqa: E402
 from flake16_framework_tpu.serve import (  # noqa: E402
     ModelRegistry, RetriableRejection, ScoringService,
@@ -409,6 +411,43 @@ def test_slo_shed_accounting():
     s = mon.summary(now=2.0)
     assert s["shed_total"] == 3
     assert s["serve_shed_pct"] == 75.0  # 3 shed / (1 observed + 3 shed)
+
+
+def test_fleet_burn_merges_worker_streams():
+    """ISSUE 19: the fleet monitor burns on the MERGED stream — a hot
+    worker that alone breaches its local monitor shows up diluted at
+    fleet level (the router deprioritizes, never sheds) — and
+    ``budget_spend`` over two snapshots reproduces the interval's burn
+    exactly (the rolling-restart annotation math)."""
+    cfg = SLOConfig(p99_ms=10.0, latency_budget=0.05, error_budget=0.02,
+                    fast_window_s=1.0, slow_window_s=4.0, min_events=4,
+                    degrade=False)
+    fleet_mon, w0, w1 = SLOMonitor(cfg), SLOMonitor(cfg), SLOMonitor(cfg)
+    t0 = 5000.0
+    before = fleet_mon.budget_snapshot()
+    assert before == {"events": 0, "errors": 0, "over_latency": 0}
+    # worker 0 healthy (1 ms), worker 1 hot (every request over the
+    # 10 ms objective); the fleet monitor sees the union
+    for i in range(30):
+        w0.observe(latency_ms=1.0, now=t0 + i * 0.01)
+        fleet_mon.observe(latency_ms=1.0, now=t0 + i * 0.01)
+    for i in range(10):
+        w1.observe(latency_ms=50.0, now=t0 + i * 0.01)
+        fleet_mon.observe(latency_ms=50.0, now=t0 + i * 0.01)
+    s0 = w0.evaluate(now=t0 + 0.4)
+    s1 = w1.evaluate(now=t0 + 0.4)
+    sf = fleet_mon.evaluate(now=t0 + 0.4)
+    assert s0["burn_fast"] == 0.0 and not w0.shedding
+    assert s1["burn_fast"] == 20.0 and w1.shedding  # local view: breach
+    # fleet view: 10/40 over budget -> (0.25)/0.05 = 5.0 — real spend,
+    # but diluted: the signal that drives deprioritization, not a shed
+    assert sf["burn_fast"] == 5.0
+    after = fleet_mon.budget_snapshot()
+    spend = budget_spend(before, after, cfg)
+    assert spend == {"events": 40, "errors": 0, "over_latency": 10,
+                     "burn": 5.0}
+    # an idle interval spends nothing
+    assert budget_spend(after, after, cfg)["burn"] == 0.0
 
 
 def test_clear_pallas_broken_contract():

@@ -6,6 +6,7 @@ ISSUE-12 planner drill) with no human in the loop.
     python tools/chaos_drill.py serve    # the drain drill
     python tools/chaos_drill.py flight   # SIGKILL vs the flight recorder
     python tools/chaos_drill.py fleet    # SIGKILL 1 of 3 fleet workers
+    python tools/chaos_drill.py fleet_trace  # SIGKILL mid-sampled-trace
     python tools/chaos_drill.py lockwatch  # drain + runtime lock witness
     python tools/chaos_drill.py          # default set; exit 0 iff all PASS
     python tools/chaos_drill.py --json   # machine-readable verdicts
@@ -56,6 +57,16 @@ respawns the killed worker against its restart budget, and a subsequent
 zero-drop rolling restart cycles EVERY worker (drain -> clean exit ->
 free respawn -> fresh heartbeat) with zero errors from the load running
 through it and every worker on a new pid afterwards.
+
+The fleet-trace drill (fleet_trace, ISSUE 19): a 2-worker fleet with
+telemetry armed and F16_TRACE_SAMPLE=1 — every request sampled — takes
+a SIGKILL on worker 0 under load. PASS requires: the failover window
+closes, zero client-visible errors, every failover re-dispatch event in
+the router's telemetry carries the orphaned request's ORIGINAL trace_id
+and that trace still completed (a ``fleet.request`` span on the same
+id), and the merged fleet Perfetto render (``trace --fleet``) shows the
+router plus both worker process lanes with at least one request
+stitched across processes by flow events.
 
 All drills pin JAX_PLATFORMS=cpu unless the caller overrides it, and
 share the persistent XLA compile cache with the test suite (same default
@@ -543,18 +554,162 @@ def drill_fleet(workdir):
     return verdict
 
 
+def drill_fleet_trace(workdir):
+    """SIGKILL a fleet worker mid-sampled-request (ISSUE 19): the
+    failover re-dispatch must stay on the SAME trace_id as the original
+    dispatch, and the merged fleet Perfetto render (``trace --fleet``)
+    must show the router plus both worker process lanes with at least
+    one request stitched across processes."""
+    import numpy as np
+
+    from flake16_framework_tpu import config as cfg, obs
+    from flake16_framework_tpu.obs import schema
+    from flake16_framework_tpu.obs import trace as obs_trace
+    from flake16_framework_tpu.serve.fleet import Fleet
+    from flake16_framework_tpu.serve.registry import ModelRegistry
+    from flake16_framework_tpu.serve.router import FleetRouter
+    from flake16_framework_tpu.utils import synth
+
+    t0 = time.perf_counter()
+    n_workers = 2
+    failover_deadline_s = 10.0
+
+    # Telemetry + trace sampling for the ROUTER (this process, via an
+    # explicit configure) and the WORKERS (they inherit the env at
+    # spawn). Saved/restored so later drills run un-sampled.
+    tel_root = os.path.join(workdir, "telemetry")
+    saved_env = {k: os.environ.get(k)
+                 for k in ("F16_TELEMETRY", "F16_TRACE_SAMPLE")}
+    os.environ["F16_TELEMETRY"] = tel_root
+    os.environ["F16_TRACE_SAMPLE"] = "1"
+    router_run_dir = obs.configure(tel_root)
+
+    checks = {}
+    counts = {"ok": 0}
+    errors = []
+    try:
+        feats, labels, _ = synth.make_dataset(n_tests=160, seed=7)
+        feats = np.asarray(feats)
+        reg_dir = os.path.join(workdir, "registry")
+        registry = ModelRegistry(reg_dir)
+        registry.fit_and_register(
+            list(cfg.SHAP_CONFIGS)[0], feats, labels, max_depth=6,
+            tree_overrides={"Extra Trees": 4, "Random Forest": 4},
+            persist=True)
+        model_id = registry.ids()[0]
+
+        log(f"fleet_trace: spawning {n_workers} sampled workers "
+            f"(telemetry -> {tel_root})")
+        with Fleet(reg_dir, n_workers, workdir=workdir,
+                   buckets=(4, 16)) as fleet:
+            checks["fleet_ready"] = all(h.alive() for h in fleet.workers)
+            with FleetRouter(fleet) as router:
+                stop = threading.Event()
+
+                def client(seed):
+                    i = seed
+                    while not stop.is_set():
+                        i = (i + 3) % (len(feats) - 4)
+                        try:
+                            router.score(model_id, feats[i:i + 4],
+                                         timeout=60)
+                            counts["ok"] += 1
+                        except Exception as e:  # noqa: BLE001
+                            errors.append(repr(e))
+
+                loaders = [threading.Thread(target=client, args=(s,),
+                                            daemon=True)
+                           for s in range(4)]
+                for th in loaders:
+                    th.start()
+                time.sleep(1.0)
+
+                victim = fleet.workers[0]
+                old_pid = victim.pid
+                log(f"fleet_trace: SIGKILL worker 0 (pid {old_pid}) "
+                    "mid-sampled-load")
+                os.kill(old_pid, signal.SIGKILL)
+
+                deadline = time.monotonic() + failover_deadline_s
+                while router.last_failover_s is None and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.05)
+                checks["failover_closed"] = \
+                    router.last_failover_s is not None
+
+                fleet.wait_ready([0], timeout_s=120)
+                time.sleep(1.0)  # sampled load through the restored pair
+                stop.set()
+                for th in loaders:
+                    th.join(timeout=60)
+
+        checks["zero_lost"] = not errors
+        checks["some_completed"] = counts["ok"] > 20
+    finally:
+        obs.shutdown()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # The router's own events: every failover re-dispatch must carry
+    # the orphaned request's ORIGINAL trace_id, and that trace must
+    # still have completed (a fleet.request span on the same id).
+    ev_path = os.path.join(router_run_dir, schema.EVENTS_FILE)
+    with open(ev_path) as fd:
+        events = [json.loads(line) for line in fd if line.strip()]
+    redisp = [e for e in events
+              if e.get("kind") == "fleet"
+              and e.get("action") == "redispatch" and e.get("failover")]
+    span_tids = {e.get("trace_id") for e in events
+                 if e.get("kind") == "span"
+                 and e.get("name") == "fleet.request"}
+    checks["failover_redispatched"] = bool(redisp)
+    checks["failover_same_trace"] = any(
+        e.get("trace_id") in span_tids for e in redisp)
+
+    # The merged render: one process lane per worker plus the router,
+    # request lanes stitched across processes via flow events.
+    out_path, trace = obs_trace.write_fleet_trace(tel_root)
+    other = trace.get("otherData", {})
+    procs = other.get("processes", {})
+    worker_pids = {p for p, name in procs.items()
+                   if str(name).startswith("worker")}
+    checks["render_router_lane"] = "1" in procs
+    checks["render_worker_lanes"] = len(worker_pids) >= n_workers
+    checks["render_stitched"] = other.get("stitched_traces", 0) >= 1
+
+    verdict = {"drill": "fleet_trace", "pass": all(checks.values()),
+               "checks": checks,
+               "completed": counts["ok"],
+               "redispatches_on_trace": len(redisp),
+               "stitched_traces": other.get("stitched_traces", 0),
+               "processes": procs,
+               "merged_trace": out_path,
+               "wall_s": round(time.perf_counter() - t0, 2)}
+    if errors:
+        verdict["errors"] = errors[:10]
+    log(f"fleet_trace: {counts['ok']} requests ok, "
+        f"{len(redisp)} failover redispatches on-trace, "
+        f"{other.get('stitched_traces', 0)} stitched, "
+        f"processes={procs}")
+    return verdict
+
+
 def main(argv=None):
     args = sys.argv[1:] if argv is None else list(argv)
     as_json = "--json" in args
     keep = "--keep" in args
     names = [a for a in args if not a.startswith("--")] or \
-        ["sweep", "plan", "serve", "flight", "fleet"]
+        ["sweep", "plan", "serve", "flight", "fleet", "fleet_trace"]
     # lockwatch is invocable by name but NOT in the default set: it
     # re-runs the serve child with tracing on — a diagnosis/CI drill,
     # not part of the everyday all-drills sweep.
     drills = {"sweep": drill_sweep, "plan": drill_plan,
               "serve": drill_serve, "flight": drill_flight,
-              "fleet": drill_fleet, "lockwatch": drill_lockwatch}
+              "fleet": drill_fleet, "fleet_trace": drill_fleet_trace,
+              "lockwatch": drill_lockwatch}
     unknown = [n for n in names if n not in drills]
     if unknown:
         raise SystemExit(f"chaos_drill: unknown drill(s) {unknown}; "

@@ -419,6 +419,25 @@ def chain():
             pass
     if not ok_fl:
         log("fleet drill FAILED — continuing device chain (see log)")
+    # Fleet observability drill (ISSUE 19): SIGKILL a worker while every
+    # request is trace-sampled — failover re-dispatch must stay on the
+    # orphaned request's trace_id and the merged Perfetto render must
+    # stitch router + both worker lanes. Same non-gating contract as
+    # chaos/lockwatch/fleet: observability-plane evidence banked for the
+    # next session, never a device-chain gate.
+    ok_ft, out_ft, _ = run_stage(
+        "fleet_trace", [py, os.path.join(REPO, "tools", "chaos_drill.py"),
+                        "fleet_trace", "--json"], 1800)
+    if out_ft and "{" in out_ft:
+        try:
+            rec = json.loads(out_ft[out_ft.index("{"):])
+            with open(os.path.join(REPO, "_scratch",
+                                   "fleet_trace_drill.json"), "w") as fd:
+                json.dump(rec, fd, indent=1)
+        except (ValueError, OSError):
+            pass
+    if not ok_ft:
+        log("fleet_trace drill FAILED — continuing device chain (see log)")
     # parity --full judges the hist (production) tier since ISSUE 9 —
     # the exact fallback tier no longer gates the headline record, so
     # parity runs BEFORE the exact-seed bank. The exact-tier sub-record
