@@ -604,6 +604,58 @@ def worker(n_tests, n_trees):
     }), flush=True)
 
 
+def tuned_provenance(backend, n_tests, n_trees):
+    """``detail.tuned_from`` (ISSUE 20 satellite): the perfdb identity +
+    crc digest of every tuned row active for this probe's families — a
+    row counts as active when the plan-time consult applies it
+    (perfdb.tuned_fit_overrides non-empty) or its full winner env is
+    exported (the parity-affecting activation path, e.g. the watcher's
+    bench_tuned stage). ``bench --gate`` cross-checks each digest
+    against the live database, so a stale/rewritten tuning DB cannot
+    silently claim a tuned headline. None when nothing tuned is active
+    (the record then carries no tuned_from field, like every pre-tuner
+    round)."""
+    from flake16_framework_tpu.obs import perfdb
+    from flake16_framework_tpu.parallel import planner, sweep
+
+    db = perfdb.default_db(None)
+    if db is None or not os.path.isfile(db):
+        return None
+    try:
+        rows = perfdb.load(db)
+    except Exception:
+        return None
+    out = []
+    seen = set()
+    for keys in CONFIGS:
+        fam = (keys[1], keys[4])
+        if fam in seen:
+            continue
+        seen.add(fam)
+        shape = planner.plan_shape(
+            fam[0], fam[1], n=n_tests, n_folds=sweep.N_FOLDS,
+            tree_overrides={"Random Forest": n_trees,
+                            "Extra Trees": n_trees})
+        row = perfdb.tuned_fit_row(backend, shape, model=fam[1],
+                                   rows=rows)
+        if row is None:
+            continue
+        applied = perfdb.tuned_fit_overrides(backend, shape,
+                                             model=fam[1], rows=rows)
+        knobs = row.get("knobs") or {}
+        env_active = bool(knobs) and all(
+            os.environ.get(k) == str(v) for k, v in knobs.items())
+        if not applied and not env_active:
+            continue
+        out.append({
+            "backend": row.get("backend"), "shape": row.get("shape"),
+            "kernel": row.get("kernel"), "ksig": row.get("ksig"),
+            "src": row.get("src"), "crc": row.get("crc"),
+            "applied": applied or None, "env_active": env_active,
+        })
+    return out or None
+
+
 def probe():
     """Quick device sanity check in a subprocess (the tunnel can hang).
 
@@ -1053,6 +1105,12 @@ def main():
         if result["t_shap"] else None,
         backend=result.get("backend"),
     )
+    # Tuned-knob provenance (ISSUE 20): which tuned perfdb rows were
+    # active for this measurement, by identity + crc — the digest
+    # `bench --gate` cross-checks against the live database.
+    tuned_from = tuned_provenance(result.get("backend"), n, t)
+    if tuned_from:
+        detail["tuned_from"] = tuned_from
     print(json.dumps({
         "metric": tag + "_speedup",
         "value": round(speedup, 3),

@@ -36,6 +36,17 @@ plus extension verbs the reference lacks:
         # round and top contributing stages (tier-1-safe after
         # `bench --gate`); `lookup BACKEND SHAPE [KERNEL]` prints the
         # best-known knob row the planner/serve store consult
+    python -m flake16_framework_tpu tune [--family FS/Model] [--dry-run]
+        [--min-gain PCT] [--no-parity-knobs] [--db PATH]
+        # f16tune (perf/tuner.py): bench-in-the-loop autotuner over the
+        # declared KnobSpace — successive-halving search per (backend,
+        # plan shape, model family) with fresh-subprocess bench probes
+        # as the oracle, seeded from committed BENCH history and I401
+        # audit envelopes; winners past the gain floor land as `tuned`
+        # perfdb rows the planner consults at plan time (absent rows
+        # keep today's defaults byte-for-byte). Parity-affecting
+        # winners (F16_HIST_BINS) re-run the parity harness before
+        # acceptance and only activate via explicit env export
     python -m flake16_framework_tpu lint [PATHS] [--json] [--baseline F]
         # f16lint: JAX/TPU-hygiene static analysis + config-grid
         # pre-flight (analysis/); exit 1 on unsuppressed findings;
@@ -227,6 +238,12 @@ def main(argv=None):
         from flake16_framework_tpu.obs.perf_diff import perf_main
 
         perf_main(args)
+    elif command == "tune":
+        from flake16_framework_tpu.perf.tuner import tune_main
+
+        code = tune_main(args)
+        if code:
+            raise SystemExit(code)
     elif command == "bench":
         # Only the gate lives behind the verb; the measurement harness
         # stays the standalone bench.py (it owns its env/backend setup).

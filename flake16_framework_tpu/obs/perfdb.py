@@ -500,10 +500,14 @@ def primary_wall(metrics):
 def lookup(backend, shape_sig_, kernel=None, path=None, rows=None):
     """The best-known knob-carrying observation for a key: among rows
     matching (backend, shape_sig) — and ``kernel`` when given — with a
-    non-null knob snapshot, the one with the lowest primary wall (ties
-    and wall-less rows fall back to recency). Returns the row, or None
-    — the safe fall-through the planner/serve consults rely on: no
-    database, no row, or no knobs means current defaults."""
+    non-null knob snapshot, the one with the lowest primary wall
+    (wall-less rows fall back to recency). Equal walls (and equal
+    recency) tie-break by row key order — (kernel, ksig, src, backend)
+    ascending — NOT file order, so wildcard consults and ``tune
+    --resume`` pick the same winner from any row permutation (recovered
+    journals reorder rows). Returns the row, or None — the safe
+    fall-through the planner/serve consults rely on: no database, no
+    row, or no knobs means current defaults."""
     if rows is None:
         rows = load(path)
     best = None
@@ -518,8 +522,10 @@ def lookup(backend, shape_sig_, kernel=None, path=None, rows=None):
         if not row.get("knobs"):
             continue
         wall = primary_wall(row.get("metrics") or {})
-        key = (0, wall) if wall is not None else \
-            (1, -float(row.get("ts") or 0.0))
+        order = (str(row.get("kernel")), str(row.get("ksig")),
+                 str(row.get("src")), str(row.get("backend")))
+        key = (0, wall, order) if wall is not None else \
+            (1, -float(row.get("ts") or 0.0), order)
         if best_key is None or key < best_key:
             best, best_key = row, key
     return best
@@ -533,6 +539,84 @@ def record_tuned(backend, shape, kernel, knobs, metrics, path=None,
                    tuned=True)
     append([row], path=path)
     return row
+
+
+def model_kernel(model_name):
+    """The per-model fit kernel key ("fit.extra_trees"): plan shapes
+    collide across models (Flake16 RF and ET share (n, f, t, k, cap)),
+    so tuned fit rows carry the model in the kernel component; plain
+    "fit" remains the family-agnostic fallback key."""
+    return "fit." + str(model_name).strip().lower().replace(" ", "_")
+
+
+# Grower kwargs a tuned fit row may override at plan time, with the env
+# pins that outrank the database (an operator/probe export must win over
+# a recorded winner) and the sanity bounds a recorded value must satisfy
+# (a corrupt row must never change execution).
+_TUNED_FIT_KNOBS = (
+    ("node_batch", ("F16_HIST_NODE_BATCH_CPU", "F16_HIST_NODE_BATCH"),
+     1, 4096),
+    ("refine_tile", ("F16_HIST_REFINE_TILE",), 0, 1 << 20),
+)
+
+
+def tuned_fit_row(backend, shape, model=None, path=None, rows=None):
+    """The best TUNED fit row for (backend, shape[, model]), or None.
+    Per-model rows (kernel ``model_kernel(model)``) outrank the
+    family-agnostic "fit" key; non-tuned rows never qualify. This is
+    both the consult's row selection and the provenance source bench.py
+    records as ``detail.tuned_from`` (key + crc digest)."""
+    if rows is None:
+        db = default_db(path)
+        if db is None or not os.path.isfile(db):
+            return None
+        try:
+            rows = load(db)
+        except Exception:
+            return None
+    sig = shape if isinstance(shape, str) else shape_sig(shape)
+    row = None
+    if model is not None:
+        row = lookup(backend, sig, kernel=model_kernel(model), rows=rows)
+    if row is None:
+        row = lookup(backend, sig, kernel="fit", rows=rows)
+    if row is None or not row.get("tuned"):
+        return None
+    return row
+
+
+def tuned_fit_overrides(backend, shape, model=None, path=None, rows=None,
+                        env=None):
+    """Sanitized grower kwargs ({"node_batch"/"refine_tile": int} subset)
+    from the best TUNED fit row for (backend, shape[, model]) — the
+    plan-time consult SweepEngine feeds into make_plan_fn. Every
+    fall-through — no database, unreadable rows, no tuned row,
+    env-pinned knob, unparsable or out-of-bounds value — yields {} and
+    the grower keeps today's defaults byte-for-byte. Parity-affecting
+    knobs (F16_HIST_BINS) are deliberately NOT in the override map:
+    they activate only via explicit env export, so the plan path can
+    never diverge from the per-config/journal-resume paths."""
+    row = tuned_fit_row(backend, shape, model=model, path=path, rows=rows)
+    if row is None:
+        return {}
+    env = os.environ if env is None else env
+    knobs = row.get("knobs") or {}
+    out = {}
+    for kwarg, env_names, lo, hi in _TUNED_FIT_KNOBS:
+        if any(name in env for name in env_names):
+            continue
+        for name in env_names:
+            raw = knobs.get(name)
+            if raw is None:
+                continue
+            try:
+                v = int(raw)
+            except (TypeError, ValueError):
+                continue
+            if lo <= v <= hi:
+                out[kwarg] = v
+                break
+    return out
 
 
 def plan_lookup(backend, path=None):

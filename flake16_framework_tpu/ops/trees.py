@@ -591,6 +591,13 @@ HIST_REFINE = os.environ.get("F16_HIST_REFINE", "exact")
 if HIST_REFINE not in ("exact", "edge"):
     raise ValueError(
         f"F16_HIST_REFINE must be exact|edge, got {HIST_REFINE!r}")
+# Sample-tile size of the exact-refinement reduce (refine="exact" only):
+# 0 runs the one-shot [N, W] masked max/min (the pre-tuner behavior); a
+# positive tile streams the same reduce over clamp-overlapped sample tiles
+# via fori_loop, bounding the materialized mask to [tile, W]. Overlap is
+# harmless (max/min are idempotent), so every tile size grows the
+# bit-identical forest — a pure perf/memory knob the tuner searches.
+HIST_REFINE_TILE = int(os.environ.get("F16_HIST_REFINE_TILE", "0"))
 # Histogram implementation override; "auto" resolves per backend + ladder
 # ("segsum" is the accepted alias for what is now the "xla" formulation).
 HIST_IMPL = os.environ.get("F16_HIST_IMPL", "auto")
@@ -755,9 +762,42 @@ def _pallas_cum_hists(ohw, ohwy, ohfb):
     )(ohw, ohwy, ohfb.transpose(1, 0, 2)))
 
 
+def _refine_minmax(act, go_left, xv, tile):
+    """(max-left, min-right) [W] of the exact-refinement reduce: per window
+    node, the largest member value routed left and the smallest routed
+    right. ``tile`` 0 (or >= N) materializes the one-shot [N, W] masks; a
+    positive tile streams the identical reduce over ``tile``-row sample
+    slices (the last tile clamps back, overlapping rows already reduced —
+    idempotent under max/min), so every tile size is bitwise-equal to the
+    one-shot path and the knob is pure perf/memory."""
+    n, bw = act.shape
+    def onestep(a, g, v):
+        m_l = jnp.max(jnp.where(a & g[:, None], v[:, None], -jnp.inf),
+                      axis=0)
+        m_r = jnp.min(jnp.where(a & ~g[:, None], v[:, None], jnp.inf),
+                      axis=0)
+        return m_l, m_r
+    if not tile or tile >= n:
+        return onestep(act, go_left, xv)
+
+    def body(i, carry):
+        m_l, m_r = carry
+        s = jnp.minimum(i * tile, n - tile)
+        t_l, t_r = onestep(
+            lax.dynamic_slice_in_dim(act, s, tile),
+            lax.dynamic_slice_in_dim(go_left, s, tile),
+            lax.dynamic_slice_in_dim(xv, s, tile),
+        )
+        return jnp.maximum(m_l, t_l), jnp.minimum(m_r, t_r)
+
+    init = (jnp.full((bw,), -jnp.inf, xv.dtype),
+            jnp.full((bw,), jnp.inf, xv.dtype))
+    return lax.fori_loop(0, -(-n // tile), body, init)
+
+
 def _fit_one_tree_hist(x, ohfb, bin_idx, edges, y01, w, key, *, random_splits,
                        max_features, max_depth, max_nodes, node_batch,
-                       hist_impl, refine):
+                       hist_impl, refine, refine_tile):
     """Grow one tree from binned features. Returns Forest field arrays
     (same contract as ``_fit_one_tree``). ``hist_impl`` arrives resolved
     and canonical ("xla" | "einsum" | "pallas"); ``node_batch`` is the BFS
@@ -965,10 +1005,7 @@ def _fit_one_tree_hist(x, ohfb, bin_idx, edges, y01, w, key, *, random_splits,
             # covers, and leaf values are bit-identical to refine="edge";
             # only the stored threshold moves.
             act = onehot & can_mine[:, None]           # [N, W]
-            mL = jnp.max(jnp.where(act & go_left[:, None],
-                                   xv_mine[:, None], -jnp.inf), axis=0)
-            mR = jnp.min(jnp.where(act & ~go_left[:, None],
-                                   xv_mine[:, None], jnp.inf), axis=0)
+            mL, mR = _refine_minmax(act, go_left, xv_mine, refine_tile)
             mid = ((mL + mR) * 0.5).astype(dt)
             # sklearn's guard: a midpoint that rounds up to the right value
             # falls back to the left value (threshold rule is x <= thr)
@@ -1022,12 +1059,12 @@ def _fit_one_tree_hist(x, ohfb, bin_idx, edges, y01, w, key, *, random_splits,
     static_argnames=(
         "n_trees", "bootstrap", "random_splits", "sqrt_features", "max_depth",
         "max_nodes", "tree_chunk", "n_bins", "hist_impl", "node_batch",
-        "refine",
+        "refine", "refine_tile",
     ),
 )
 def _fit_forest_hist_core(x, y, w, key, *, n_trees, bootstrap, random_splits,
                           sqrt_features, max_depth, max_nodes, tree_chunk,
-                          n_bins, hist_impl, node_batch, refine,
+                          n_bins, hist_impl, node_batch, refine, refine_tile,
                           edges=None, tree_keys=None):
     """The jitted grower program; every static is resolved by the
     ``fit_forest_hist`` wrapper. Instrumented below, so host dispatches
@@ -1057,6 +1094,7 @@ def _fit_forest_hist_core(x, y, w, key, *, n_trees, bootstrap, random_splits,
             random_splits=random_splits, max_features=max_features,
             max_depth=max_depth, max_nodes=max_nodes,
             node_batch=node_batch, hist_impl=hist_impl, refine=refine,
+            refine_tile=refine_tile,
         )
 
     feature, threshold, left, right, value, n_nodes = _map_trees(
@@ -1070,7 +1108,7 @@ def fit_forest_hist(x, y, w, key, *, n_trees, bootstrap, random_splits,
                     sqrt_features, max_depth=48, max_nodes=None,
                     tree_chunk=None, n_bins=HIST_BINS, edges=None,
                     tree_keys=None, hist_impl=None, node_batch=None,
-                    refine=None):
+                    refine=None, refine_tile=None):
     """Histogram-grower twin of ``fit_forest`` (same signature + ``n_bins``/
     ``edges``/``hist_impl``/``node_batch``/``refine``). ``edges``
     [F, n_bins-1] may be precomputed (e.g. once per config from the full
@@ -1088,9 +1126,11 @@ def fit_forest_hist(x, y, w, key, *, n_trees, bootstrap, random_splits,
     pallas rung marked broken ("einsum"). A first-ever Mosaic failure under
     auto degrades pallas -> einsum HERE (host dispatches only — under an
     enclosing trace resolution is trace-time) and is remembered; an
-    EXPLICIT "pallas" still raises. ``node_batch``/``refine`` default from
-    the backend width heuristic and F16_HIST_REFINE; forests depend only on
-    data + key (impl and width neutral — refine="edge" moves thresholds)."""
+    EXPLICIT "pallas" still raises. ``node_batch``/``refine``/
+    ``refine_tile`` default from the backend width heuristic,
+    F16_HIST_REFINE, and F16_HIST_REFINE_TILE; forests depend only on data
+    + key (impl, width, and tile neutral — refine="edge" moves
+    thresholds)."""
     if max_nodes is None:
         max_nodes = 2 * x.shape[0]
     if node_batch is None:
@@ -1099,6 +1139,9 @@ def fit_forest_hist(x, y, w, key, *, n_trees, bootstrap, random_splits,
                       else HIST_NODE_BATCH)
     if refine is None:
         refine = HIST_REFINE
+    if refine_tile is None:
+        refine_tile = HIST_REFINE_TILE
+    refine_tile = int(refine_tile)
     explicit = hist_impl if hist_impl is not None else (
         None if HIST_IMPL == "auto" else HIST_IMPL)
     impl = _canon_hist_impl(explicit) if explicit else _auto_hist_impl()
@@ -1111,7 +1154,7 @@ def fit_forest_hist(x, y, w, key, *, n_trees, bootstrap, random_splits,
             random_splits=random_splits, sqrt_features=sqrt_features,
             max_depth=max_depth, max_nodes=max_nodes, tree_chunk=tree_chunk,
             n_bins=n_bins, hist_impl=i, node_batch=node_batch, refine=refine,
-            edges=edges, tree_keys=tree_keys)
+            refine_tile=refine_tile, edges=edges, tree_keys=tree_keys)
 
     if explicit or impl != "pallas":
         return call(impl)

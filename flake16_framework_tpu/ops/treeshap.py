@@ -267,10 +267,20 @@ def forest_shap_class0(forest, x, *, sample_chunk=None, impl="auto",
         # F16_SHAP_TREE_CHUNK is consulted LIVE (per explain, not once at
         # import) so a mid-process export — e.g. an operator reacting to a
         # tunnel fault — takes effect on the next call and still rides the
-        # ladder's halving path below.
+        # ladder's halving path below. It is also a registered f16tune
+        # knob (perf/tuner.py KNOBSPACE, target "shap", results-neutral):
+        # the autotuner's winners export through this same read, so the
+        # searched value and the operator override share one precedence
+        # (explicit env beats any recorded winner).
         if tree_chunk is None:
             env = os.environ.get("F16_SHAP_TREE_CHUNK", "").strip()
-            tree_chunk = int(env) if env else None
+            try:
+                # floor 1 (G106 validator bound); a malformed export must
+                # degrade to the unchunked default, not kill the explain —
+                # this read sits on the serve path.
+                tree_chunk = max(1, int(env)) if env else None
+            except ValueError:
+                tree_chunk = None
         sample_chunk = _ladder.halved(sample_chunk)
         tree_chunk = _ladder.halved(tree_chunk)
         m = forest.feature.shape[-1]

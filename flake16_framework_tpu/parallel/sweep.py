@@ -127,7 +127,8 @@ def _auto_tree_chunk(spec, n_folds, tree_chunk, use_hist):
 
 
 def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
-                     n_folds=N_FOLDS, tree_chunk=None, grower=None):
+                     n_folds=N_FOLDS, tree_chunk=None, grower=None,
+                     fit_overrides=None):
     """The per-config CV pipeline, unjitted: (fit_one, score_one).
 
     fit_one(x, y_raw, flaky_label, prep_code, bal_code, key, train_mask)
@@ -166,6 +167,13 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
             f"grower/F16_ENSEMBLE_GROWER must be hist|exact, got {g!r}")
     use_hist = spec.n_trees > 1 and g == "hist"
     tree_chunk = _auto_tree_chunk(spec, n_folds, tree_chunk, use_hist)
+    # Tuned grower kwargs from the performance observatory's plan-time
+    # consult (obs/perfdb.tuned_fit_overrides — sanitized there; both
+    # knobs are results-neutral by the grower contract). Hist-tier only:
+    # the exact grower has no node batch or refinement pass. None/{}
+    # keeps the call byte-for-byte today's defaults.
+    fit_kw = {k: v for k, v in (fit_overrides or {}).items()
+              if use_hist and k in ("node_batch", "refine_tile")}
 
     def _prep(x, y_raw, flaky_label, prep_code):
         y = y_raw == flaky_label
@@ -190,7 +198,8 @@ def _make_config_fns(spec, *, n, n_projects, cap=None, max_depth=48,
             max_nodes=max_nodes, tree_chunk=chunk, tree_keys=tks,
         )
         if use_hist:
-            return trees.fit_forest_hist(xs, ys, ws, kf, edges=edges, **kw)
+            return trees.fit_forest_hist(xs, ys, ws, kf, edges=edges,
+                                         **fit_kw, **kw)
         return trees.fit_forest(xs, ys, ws, kf, **kw)
 
     def _fold_fit(xp, y, bal_code, edges, fold_key, w_train, tks):
@@ -510,7 +519,7 @@ def make_sharded_cv_fns(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
 
 
 def make_plan_fn(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
-                 n_folds=N_FOLDS, grower=None):
+                 n_folds=N_FOLDS, grower=None, fit_overrides=None):
     """ONE whole-plan program — the planner's executor kernel: the fused
     per-config CV pipeline (run_all_folds_one: preprocess -> resample ->
     fit -> predict -> confusion) mapped over the plan's padded config
@@ -537,7 +546,7 @@ def make_plan_fn(spec, mesh, *, n, n_feat, n_projects, max_depth=48,
     #families dispatches of this program plus O(1) host work."""
     fns = _make_config_fns(
         spec, n=n, n_projects=n_projects, max_depth=max_depth,
-        n_folds=n_folds, grower=grower,
+        n_folds=n_folds, grower=grower, fit_overrides=fit_overrides,
     )
     run_all_folds_one = fns[8]
 
@@ -1082,11 +1091,28 @@ class SweepEngine:
             )
         return self._sharded_fns[key]
 
+    def _tuned_fit_overrides(self, fs_name, model_name):
+        """The performance observatory's plan-time grower consult for one
+        family: sanitized tuned-row kwargs (perfdb.tuned_fit_overrides)
+        for this engine's plan shape, keyed per model (plan shapes
+        collide across RF/ET). {} — no database, no tuned row, env pin —
+        keeps the compiled program byte-for-byte today's."""
+        from flake16_framework_tpu.obs import perfdb
+
+        shape = planner.plan_shape(
+            fs_name, model_name, n=self.features.shape[0],
+            n_folds=self.n_folds, tree_overrides=self.tree_overrides)
+        return perfdb.tuned_fit_overrides(
+            jax.default_backend(), shape, model=model_name)
+
     def _get_plan_fn(self, fs_name, model_name):
         """The family's whole-plan executor program (make_plan_fn),
         compiled against this engine's mesh (or single-device vmap when
-        none) — cached like _get_fns/_get_sharded_fns."""
-        key = (fs_name, model_name)
+        none) — cached like _get_fns/_get_sharded_fns. Tuned grower
+        overrides join the cache key: a tuning DB appearing between
+        sweeps recompiles rather than reusing a stale program."""
+        overrides = self._tuned_fit_overrides(fs_name, model_name)
+        key = (fs_name, model_name, tuple(sorted(overrides.items())))
         if key not in self._plan_fns:
             n, _ = self.features.shape
             cols = list(cfg.FEATURE_SETS[fs_name])
@@ -1096,7 +1122,7 @@ class SweepEngine:
                     n_feat=len(cols),
                     n_projects=len(self.project_names),
                     max_depth=self.max_depth, n_folds=self.n_folds,
-                    grower=self.grower,
+                    grower=self.grower, fit_overrides=overrides,
                 ),
                 cols,
             )

@@ -1,0 +1,16 @@
+"""Performance tuning plane (ISSUE 20): the f16tune autotuner.
+
+``perf.tuner`` owns the declared knob space (KNOBSPACE — the typed
+registry f16lint's G108 audits kernel constants against) and the
+bench-in-the-loop search that turns it into ``tuned`` perfdb rows the
+planner consults at plan time (obs/perfdb.tuned_fit_overrides). This
+package is import-light on purpose: no jax at import, so the lint/G108
+census and the CLI help path never touch a device."""
+
+from flake16_framework_tpu.perf.tuner import (  # noqa: F401
+    KNOBSPACE,
+    Knob,
+    knobspace,
+    registered_env_names,
+    tune_main,
+)

@@ -88,6 +88,9 @@ def torn_artifact_write(doc):
         fd.write(doc)
 
 
+RESIDUAL_SCAN_TILE = 96                           # expect G108
+
+
 @executor_scope
 def per_config_loop_in_executor(engine, plan):
     out = []

@@ -32,8 +32,8 @@ from flake16_framework_tpu.obs import schema  # noqa: E402
 
 EXPECTED_FIXTURE_RULES = {
     "J101", "J102", "J103", "J104", "J201", "J202", "J203", "J301",
-    "J401", "J402", "J501", "J601", "J701", "G107", "O102", "O103",
-    "O104", "O105", "O106", "O107",
+    "J401", "J402", "J501", "J601", "J701", "G107", "G108", "O102",
+    "O103", "O104", "O105", "O106", "O107",
     # f16race (rules_conc) — the concurrency pack seeds
     "C101", "C201", "C301", "C401", "C501", "C502", "C503",
 }

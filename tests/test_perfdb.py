@@ -59,11 +59,24 @@ def test_backfill_idempotent(committed_db):
 
 def test_historical_rounds_backfill_null_knobs(committed_db):
     # Satellite 16a: rounds benched before the knob snapshot existed
-    # ingest with knobs: null — lookup must never consult them.
+    # (r01–r09) ingest with knobs: null — lookup must never consult
+    # them. r10 (the first tuned round, ISSUE 20) carries its snapshot:
+    # the F16_HIST_BINS=32 winner env rode the bench record in.
     db, _ = committed_db
     rows = [r for r in perfdb.load(db) if r["src"].startswith("BENCH_r")]
-    assert rows and all(r["knobs"] is None for r in rows)
-    assert perfdb.lookup("cpu", rows[0]["shape"], path=db) is None
+    hist = [r for r in rows if r["round"] != "r10"]
+    assert hist and all(r["knobs"] is None for r in hist)
+    tuned_round = [r for r in rows if r["round"] == "r10"]
+    assert tuned_round and all(
+        (r["knobs"] or {}).get("F16_HIST_BINS") == "32"
+        for r in tuned_round)
+    # null-knob history never resolves at the probe shape...
+    shape = tuned_round[0]["shape"]
+    assert any(r["shape"] == shape for r in hist)
+    assert perfdb.lookup("cpu", shape, rows=hist) is None
+    # ...but the same shape NOW resolves — to a knob-carrying r10 row
+    found = perfdb.lookup("cpu", shape, path=db)
+    assert found is not None and found["round"] == "r10"
 
 
 def test_torn_tail_recovery(tmp_path):
@@ -151,8 +164,18 @@ def test_sentinel_names_committed_fit_wall_step(committed_db):
     assert step["stages"] and all(
         s["delta_s"] > 0 and s["metric"] in perfdb.WALL_METRICS
         for s in step["stages"])
-    # settled history: the latest committed round opens no fresh step,
-    # so the post-gate strict posture passes
+    # r10's fit-wall IMPROVEMENT (13.9 -> 8.7 s, f16tune) is reported
+    # as a benign step, never an adverse one
+    gains = [s for s in result["steps"]
+             if s["kernel"] == "fit" and s["metric"] == "wall_s"
+             and s["round"] == "r10"]
+    assert gains and not gains[0]["adverse"]
+    # the two r10 container/model-accounting steps carry their reviewed
+    # waiver (perf_diff.STEP_WAIVERS) — reported, but not strict-failing
+    waived = {(s["kernel"], s["metric"]) for s in result["steps"]
+              if s.get("waived")}
+    assert waived == {("fit", "gflops"), ("shap_interact", "wall_s")}
+    # settled history + waived head steps: the strict posture passes
     assert result["latest_regressions"] == []
     perf_diff.perf_main(["sentinel", "--db", db, "--strict"],
                         out=io.StringIO())

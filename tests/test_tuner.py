@@ -1,0 +1,359 @@
+"""f16tune autotuner (ISSUE 20): KnobSpace registry typing and census
+coherence with the G106/G108 lint registries, deterministic successive
+halving (same history + seed -> same winner), the parity-affecting
+rejection path (a red parity harness pops the winner and the search
+falls to the best results-neutral candidate), perfdb seeding (history
+walls, audit-envelope width veto), the plan-time consult's fall-through
+contract (absent/corrupt/garbage databases change nothing, env pins
+outrank rows), the satellite-2 wildcard lookup tie-break, and the tiled
+exact-refinement's bitwise identity (the grower contract that makes
+F16_HIST_REFINE_TILE results-neutral)."""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from flake16_framework_tpu.analysis import rules_grid
+from flake16_framework_tpu.obs import perfdb
+from flake16_framework_tpu.parallel import planner
+from flake16_framework_tpu.perf import tuner
+
+RF = "Random Forest"
+FS = "Flake16"
+
+
+def rf_shape(n=400, n_trees=25, n_folds=10):
+    return planner.plan_shape(
+        FS, RF, n=n, n_folds=n_folds,
+        tree_overrides={m: n_trees for m in tuner.ENSEMBLES})
+
+
+def table_measure(table, default=10.0):
+    """Deterministic oracle: env (sorted items) -> wall seconds."""
+    calls = []
+
+    def measure(env, reps):
+        calls.append((tuple(sorted(env.items())), reps))
+        return table.get(tuple(sorted(env.items())), default)
+
+    return measure, calls
+
+
+def key(**env):
+    return tuple(sorted({k: str(v) for k, v in env.items()}.items()))
+
+
+# -- KnobSpace registry ------------------------------------------------------
+
+
+def test_knobspace_is_typed_and_census_coherent():
+    assert tuner.KNOBSPACE, "empty KnobSpace"
+    for k in tuner.KNOBSPACE:
+        assert k.name.startswith("F16_")
+        assert k.domain and all(isinstance(v, str) for v in k.domain)
+        assert isinstance(k.default, str)
+        assert isinstance(k.parity_affecting, bool)
+        assert k.target in ("fit", "shap")
+        assert callable(k.applies)
+        assert k.note
+        # every registered knob is G106-censused: the lint registry and
+        # the tuner registry must never drift apart
+        assert k.name in rules_grid.KNOBS, k.name
+    # and the G108 accept-set is exactly the registered names
+    assert tuner.registered_env_names() == frozenset(
+        k.name for k in tuner.KNOBSPACE)
+
+
+def test_applicability_predicates_gate_by_backend_and_model():
+    shape = rf_shape()
+    cpu_rf = {k.name for k in tuner.applicable_knobs(
+        shape, "cpu", RF, env={})}
+    assert "F16_HIST_NODE_BATCH_CPU" in cpu_rf
+    assert "F16_HIST_NODE_BATCH" not in cpu_rf
+    assert "F16_HIST_REFINE_TILE" in cpu_rf
+    tpu_et = {k.name for k in tuner.applicable_knobs(
+        shape, "tpu", "Extra Trees", env={})}
+    assert "F16_HIST_NODE_BATCH" in tpu_et
+    assert "F16_HIST_NODE_BATCH_CPU" not in tpu_et
+    # ET draws thresholds randomly — exact refinement never runs
+    assert "F16_HIST_REFINE_TILE" not in tpu_et
+    # no ensemble knob applies to a non-ensemble model
+    assert not tuner.applicable_knobs(shape, "cpu", "Decision Tree",
+                                      env={})
+
+
+def test_env_pin_excludes_knob_from_search():
+    shape = rf_shape()
+    pinned = {k.name for k in tuner.applicable_knobs(
+        shape, "cpu", RF, env={"F16_HIST_NODE_BATCH_CPU": "8"})}
+    assert "F16_HIST_NODE_BATCH_CPU" not in pinned
+    assert "F16_HIST_REFINE_TILE" in pinned
+
+
+def test_candidate_field_is_base_plus_single_knob_minus_defaults():
+    knobs = tuner.applicable_knobs(rf_shape(), "cpu", RF, env={})
+    field = tuner.candidates(knobs)
+    assert field[0] == ("base", {})
+    names = [n for n, _ in field]
+    assert len(names) == len(set(names))
+    # default values never re-measured as candidates
+    assert "F16_HIST_REFINE_TILE=0" not in names
+    assert "F16_HIST_BINS=64" not in names
+    assert "F16_HIST_BINS=32" in names
+    for _, env in field[1:]:
+        assert len(env) == 1
+
+
+# -- perfdb seeding ----------------------------------------------------------
+
+
+def seed_rows():
+    return [
+        perfdb.make_row("cpu", "probe.n400.t25", "config.A", {"fit_s": 3.0},
+                        src="BENCH_r09"),
+        perfdb.make_row("cpu", "probe.n400.t25", "config.B", {"fit_s": 2.0},
+                        src="BENCH_r09"),
+        perfdb.make_row("cpu", "probe.n400.t25", "config.A", {"fit_s": 9.0},
+                        src="BENCH_r08"),  # incomplete family: no B
+        perfdb.make_row("cpu", "audit", "audit.plan_peak",
+                        {"peak_mb": 900.0}, src="audit"),
+    ]
+
+
+def test_family_history_wall_sums_complete_families_only():
+    wall = tuner.family_history_wall(
+        seed_rows(), "cpu", 400, 25, {"config.A"[len("config."):],
+                                      "config.B"[len("config."):]})
+    assert wall == pytest.approx(5.0)  # r09 complete; r08 missing B
+    assert tuner.family_history_wall([], "cpu", 400, 25, {"A"}) is None
+
+
+def test_audit_envelope_vetoes_wide_node_batch():
+    peak = tuner.audit_peak_mb(seed_rows())
+    assert peak == pytest.approx(900.0)
+    # width 16 doubles the audited 900 MB envelope past a 1.5 GB cap
+    assert tuner.mem_vetoed({"F16_HIST_NODE_BATCH_CPU": "16"}, peak, 1536.0)
+    # width <= the audited default is never vetoed
+    assert not tuner.mem_vetoed({"F16_HIST_NODE_BATCH_CPU": "8"}, peak,
+                                1536.0)
+    # no envelope on record, no veto
+    assert not tuner.mem_vetoed({"F16_HIST_NODE_BATCH_CPU": "16"}, None,
+                                1536.0)
+    # non-width candidates pass
+    assert not tuner.mem_vetoed({"F16_HIST_REFINE_TILE": "512"}, peak,
+                                1536.0)
+
+
+# -- the search --------------------------------------------------------------
+
+
+def test_successive_halving_keeps_running_min_and_sorts_by_name():
+    seq = {"a": [5.0, 4.0, 6.0], "b": [5.0, 5.0, 5.0], "c": [7.0] * 3}
+    hits = {n: 0 for n in seq}
+
+    def measure(env, reps):
+        name = env["NAME"]
+        w = seq[name][min(hits[name], 2)]
+        hits[name] += 1
+        return w
+
+    cands = [(n, {"NAME": n}) for n in ("a", "b", "c")]
+    walls = tuner.successive_halving(cands, measure, min_survivors=2)
+    # running min: a's rung-2 regression to 6.0 cannot un-win it
+    assert walls["a"] == 4.0 and walls["b"] == 5.0
+
+
+def test_tune_family_deterministic_same_history_same_winner(tmp_path):
+    table = {
+        key(): 10.0,
+        key(F16_HIST_NODE_BATCH_CPU=16): 8.0,
+        key(F16_HIST_REFINE_TILE=256): 9.0,
+        key(F16_HIST_BINS=32): 8.5,
+        key(F16_HIST_NODE_BATCH_CPU=16, F16_HIST_REFINE_TILE=256,
+            F16_HIST_BINS=32): 7.5,
+    }
+    results = []
+    for run in ("one", "two"):
+        measure, _ = table_measure(table, default=9.9)
+        db = str(tmp_path / f"db_{run}.jsonl")
+        res = tuner.tune_family(
+            FS, RF, backend="cpu", n=400, n_trees=25, n_folds=10,
+            measure=measure, rows=seed_rows(), member_codes=("A", "B"),
+            parity_check=lambda env: True, db=db)
+        results.append(res)
+        row = perfdb.tuned_fit_row("cpu", res.shape, model=RF, path=db)
+        assert row is not None and row["knobs"] == res.winner_env
+    a, b = results
+    assert a.winner == b.winner
+    assert a.winner_env == b.winner_env == {
+        "F16_HIST_NODE_BATCH_CPU": "16", "F16_HIST_REFINE_TILE": "256",
+        "F16_HIST_BINS": "32"}
+    assert a.wall_s == b.wall_s == 7.5
+    assert a.walls == b.walls
+    assert a.recorded["ksig"] == b.recorded["ksig"]
+
+
+def test_parity_red_rejects_winner_falls_to_neutral(tmp_path):
+    # bins=32 is fastest, the compose rung (with bins) even faster — a
+    # red parity harness must pop BOTH and fall to the neutral width
+    table = {
+        key(): 10.0,
+        key(F16_HIST_BINS=32): 7.0,
+        key(F16_HIST_NODE_BATCH_CPU=16): 8.0,
+        key(F16_HIST_BINS=32, F16_HIST_NODE_BATCH_CPU=16): 6.8,
+    }
+    # default WORSE than base: only the table entries beat the baseline,
+    # so the compose rung merges exactly {bins=32, cpu=16} (table-keyed)
+    measure, _ = table_measure(table, default=10.5)
+    checked = []
+
+    def parity_check(env):
+        checked.append(dict(env))
+        return False
+
+    db = str(tmp_path / "db.jsonl")
+    res = tuner.tune_family(
+        FS, RF, backend="cpu", n=400, n_trees=25, n_folds=10,
+        measure=measure, parity_check=parity_check, db=db)
+    assert res.winner_env == {"F16_HIST_NODE_BATCH_CPU": "16"}
+    assert res.wall_s == 8.0
+    assert [r["reason"] for r in res.rejected] == ["parity", "parity"]
+    assert all("F16_HIST_BINS" in env for env in checked)
+    # the recorded row carries NO parity-affecting knob
+    row = perfdb.tuned_fit_row("cpu", res.shape, model=RF, path=db)
+    assert "F16_HIST_BINS" not in row["knobs"]
+
+
+def test_parity_knobs_skipped_when_no_checker():
+    measure, calls = table_measure({}, default=10.0)
+    res = tuner.tune_family(
+        FS, RF, backend="cpu", n=400, n_trees=25, n_folds=10,
+        measure=measure, parity_check=None, record=False)
+    measured = {k for env, _ in calls for k, _ in env}
+    assert "F16_HIST_BINS" not in measured  # never accept the uncheckable
+    assert res.rejected == []
+
+
+def test_gain_floor_keeps_defaults_and_writes_no_row(tmp_path):
+    measure, _ = table_measure({}, default=10.0)  # nothing beats base
+    db = str(tmp_path / "db.jsonl")
+    res = tuner.tune_family(
+        FS, RF, backend="cpu", n=400, n_trees=25, n_folds=10,
+        measure=measure, parity_check=lambda env: True, db=db)
+    assert res.winner == "base" and res.winner_env == {}
+    assert res.recorded is None
+    assert not os.path.exists(db)
+
+
+# -- plan-time consult: fall-through contract --------------------------------
+
+
+def test_overrides_absent_db_is_empty(tmp_path):
+    shape = rf_shape()
+    missing = str(tmp_path / "nope.jsonl")
+    assert perfdb.tuned_fit_overrides("cpu", shape, model=RF,
+                                      path=missing) == {}
+
+
+def test_overrides_corrupt_db_is_empty(tmp_path):
+    shape = rf_shape()
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("{torn garbage\x00\nnot json either\n")
+    assert perfdb.tuned_fit_overrides("cpu", shape, model=RF,
+                                      path=str(bad)) == {}
+
+
+def test_overrides_sanitize_and_env_pin(tmp_path):
+    shape = rf_shape()
+    db = str(tmp_path / "db.jsonl")
+    perfdb.record_tuned(
+        "cpu", perfdb.shape_sig(shape), perfdb.model_kernel(RF),
+        {"F16_HIST_NODE_BATCH_CPU": "16", "F16_HIST_REFINE_TILE": "256",
+         "F16_HIST_BINS": "32"}, {"fit_s": 1.0}, path=db)
+    got = perfdb.tuned_fit_overrides("cpu", shape, model=RF, path=db,
+                                     env={})
+    # parity-affecting bins NEVER auto-apply at plan time
+    assert got == {"node_batch": 16, "refine_tile": 256}
+    # explicit env pin outranks the recorded row, per knob
+    got = perfdb.tuned_fit_overrides(
+        "cpu", shape, model=RF, path=db,
+        env={"F16_HIST_NODE_BATCH_CPU": "8"})
+    assert got == {"refine_tile": 256}
+    # other backend / other model: no row, no overrides
+    assert perfdb.tuned_fit_overrides("tpu", shape, model=RF,
+                                      path=db, env={}) == {}
+    assert perfdb.tuned_fit_overrides("cpu", shape, model="Extra Trees",
+                                      path=db, env={}) == {}
+
+
+def test_overrides_reject_garbage_and_out_of_bounds_values(tmp_path):
+    shape = rf_shape()
+    db = str(tmp_path / "db.jsonl")
+    perfdb.record_tuned(
+        "cpu", perfdb.shape_sig(shape), perfdb.model_kernel(RF),
+        {"F16_HIST_NODE_BATCH_CPU": "not-a-number",
+         "F16_HIST_REFINE_TILE": "-5"}, {"fit_s": 1.0}, path=db)
+    assert perfdb.tuned_fit_overrides("cpu", shape, model=RF, path=db,
+                                      env={}) == {}
+
+
+def test_lookup_equal_walls_tie_break_is_order_independent():
+    mk = perfdb.make_row
+    rows = [
+        mk("cpu", "s", "fit", {"fit_s": 2.0}, knobs={"K": "1"}, src="b"),
+        mk("cpu", "s", "fit", {"fit_s": 2.0}, knobs={"K": "2"}, src="a"),
+        mk("cpu", "s", "fit", {"fit_s": 3.0}, knobs={"K": "3"}, src="0"),
+    ]
+    first = perfdb.lookup("cpu", "s", kernel="fit", rows=rows)
+    second = perfdb.lookup("cpu", "s", kernel="fit", rows=rows[::-1])
+    assert first is second is rows[1]  # best wall, then src order
+
+
+# -- CLI + engine integration ------------------------------------------------
+
+
+def test_tune_dry_run_prints_field_without_probing(monkeypatch):
+    monkeypatch.setenv("F16_PERFDB", "0")
+    buf = io.StringIO()
+    assert tuner.tune_main(["--dry-run", "--backend", "cpu",
+                            "--n", "400", "--trees", "25"],
+                           out=buf) == 0
+    rec = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert rec["verb"] == "tune" and rec["backend"] == "cpu"
+    fams = rec["families"]
+    assert f"{FS}/{RF}" in fams
+    for fam in fams.values():
+        assert fam["candidates"][0] == "base"
+    assert "F16_HIST_NODE_BATCH_CPU=16" in fams[f"{FS}/{RF}"]["candidates"]
+
+
+def test_refine_tile_is_bitwise_results_neutral():
+    """The grower contract that licenses refine_tile as results-neutral:
+    every tile (including ragged last-tile overlap) grows THE bit-exact
+    forest of the one-shot reduce."""
+    import jax
+
+    from flake16_framework_tpu.ops import trees
+
+    rng = np.random.RandomState(7)
+    x = rng.randn(257, 8)
+    y = (x[:, 0] + 0.3 * rng.randn(257)) > 0.2
+    w = np.ones(len(y))
+
+    def fit(tile):
+        return jax.tree.map(np.asarray, trees.fit_forest_hist(
+            x, y, w, jax.random.PRNGKey(3), n_trees=8, max_depth=8,
+            max_nodes=200, bootstrap=True, random_splits=False,
+            sqrt_features=True, refine="exact", refine_tile=tile))
+
+    ref = fit(0)
+    # 100 exercises the ragged last tile (257 % 100 != 0); 500 > n_rows
+    # exercises the single-oversized-tile clamp. Other widths share the
+    # same code path and are covered by the tuner's own probe runs.
+    for tile in (100, 500):
+        got = fit(tile)
+        for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
+            np.testing.assert_array_equal(a, b)

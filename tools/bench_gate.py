@@ -33,7 +33,11 @@ regressions in KIND (a 2x wall blowup, a halved speedup), not noise:
   note — new metrics must not fail against history that predates them;
 - per-config walls (``per_config_s``) are gated per shared config at
   ``PER_CONFIG_CEIL`` (noisier: single-config timings), tolerating both
-  the round-5 dict form ({fit, predict, total}) and older scalars.
+  the round-5 dict form ({fit, predict, total}) and older scalars;
+- a record claiming ``detail.tuned_from`` (ISSUE 20: tuned autotuner
+  knobs were active) is cross-checked against the LIVE perfdb: every
+  claimed row must exist by identity with the same crc, so a stale or
+  rewritten tuning DB can never silently back a tuned headline.
 
 Exit status: 0 = within tolerance, 1 = regression (every failed metric
 is named on stdout), 2 = usage/IO error.
@@ -131,8 +135,55 @@ def _config_stages(v):
     return {}
 
 
+def check_tuned_from(current, db_path=None):
+    """The tuned-provenance digest cross-check (ISSUE 20 satellite):
+    when a record claims ``detail.tuned_from``, every claimed row —
+    matched by identity (backend, shape, kernel, ksig, src) — must
+    still exist in the live perfdb WITH the same crc. A missing or
+    crc-drifted row means the tuning DB the headline was measured under
+    is not the one on disk (stale, rewritten, or recovered), and the
+    'tuned' claim cannot be trusted. Records without the field (every
+    pre-tuner round) pass untouched. Returns a list of failure strings
+    (empty = pass)."""
+    detail = (_parsed(current).get("detail") or {})
+    claims = detail.get("tuned_from")
+    if not isinstance(claims, list) or not claims:
+        return []
+    sys.path.insert(0, REPO)
+    from flake16_framework_tpu.obs import perfdb
+
+    db = perfdb.default_db(db_path)
+    if db is None or not os.path.isfile(db):
+        return [f"tuned_from: record claims {len(claims)} tuned row(s) "
+                f"but no perfdb exists at {db!r}"]
+    try:
+        rows = perfdb.load(db)
+    except Exception as e:
+        return [f"tuned_from: perfdb {db!r} unreadable ({e})"]
+    by_identity = {perfdb.row_identity(r): r.get("crc") for r in rows}
+    failures = []
+    for claim in claims:
+        if not isinstance(claim, dict):
+            failures.append(f"tuned_from: malformed claim {claim!r}")
+            continue
+        ident = (claim.get("backend"), claim.get("shape"),
+                 claim.get("kernel"), claim.get("ksig"),
+                 claim.get("src"))
+        crc = by_identity.get(ident)
+        if crc is None:
+            failures.append(
+                f"tuned_from: no perfdb row for {ident!r} — stale "
+                "tuning DB cannot claim a tuned headline")
+        elif crc != claim.get("crc"):
+            failures.append(
+                f"tuned_from: crc mismatch for {ident!r} "
+                f"(claimed {claim.get('crc')!r}, db has {crc!r})")
+    return failures
+
+
 def gate(current, history):
-    """Compare ``current`` against the last comparable ``history`` entry.
+    """Compare ``current`` against the last comparable ``history`` entry
+    and cross-check any tuned-provenance claim against the live perfdb.
     Returns {"passed", "checks", "failures", "notes", "ref"}."""
     key = comparability_key(current)
     ref = None
@@ -147,8 +198,9 @@ def gate(current, history):
             "baseline-discontinuity: no committed entry shares "
             f"(metric, unit, shap_baseline)={key!r}; nothing to gate "
             "against (see BENCH_r03 baseline_note)")
-        return {"passed": True, "checks": checks, "failures": failures,
-                "notes": notes, "ref": None}
+        failures.extend(check_tuned_from(current))
+        return {"passed": not failures, "checks": checks,
+                "failures": failures, "notes": notes, "ref": None}
 
     def check(name, cur, refv, ok, limit):
         checks.append({"metric": name, "current": cur, "ref": refv,
@@ -196,6 +248,7 @@ def gate(current, history):
                 check(f"{table}[{config}].{stage}", cs[stage],
                       rs[stage], cs[stage] <= limit, limit)
 
+    failures.extend(check_tuned_from(current))
     if not checks:
         notes.append("no shared metrics with the reference entry — "
                      "vacuous pass")

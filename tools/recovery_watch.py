@@ -514,7 +514,35 @@ def chain():
     if not stage_ok_to_continue(ok_tune, err):
         return False  # tunnel died mid-sweep: poll again, retry later
 
+    # f16tune (ISSUE 20): the KnobSpace autotuner — AFTER the audit and
+    # probe evidence is banked (its search seeds from the fresh perfdb
+    # rows those stages ingested) and BEFORE the re-bench, so the
+    # first-silicon chain banks tuned-knob results instead of shipping
+    # CPU-tuned constants to the MXU. Winners persist as tuned perfdb
+    # rows (the plan-time consult applies results-neutral ones
+    # automatically); the summary's merged winner env joins the
+    # bench_tuned export so parity-affecting winners — which activate
+    # only via explicit env — are measured too. Field of ~10 candidates
+    # x 3 halving rungs x 3 families at device probe rates, plus one
+    # parity re-check worst case.
+    f16tune_env = {}
+    ok_ft, out_ft, err = run_stage(
+        "f16tune", [py, "-m", "flake16_framework_tpu", "tune"], 14400)
+    if ok_ft and out_ft:
+        try:
+            rec = json.loads(out_ft.strip().splitlines()[-1])
+            f16tune_env = {k: str(v)
+                           for k, v in (rec.get("env") or {}).items()}
+        except (ValueError, AttributeError):
+            f16tune_env = {}
+    if not stage_ok_to_continue(ok_ft, err):
+        return False
+
     tuned = pick_tuned_env(tune_from)
+    if f16tune_env:
+        # hw_probe's same-session device picks outrank the autotuner's
+        # merged env on conflicts (they measured THIS chain's silicon).
+        tuned = {**f16tune_env, **(tuned or {})}
     if tuned:
         log("tune winners: %s" % json.dumps(tuned))
         # 4200 like the first bench stage: fresh knob combos can miss the
